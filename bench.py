@@ -1,0 +1,85 @@
+"""Benchmark: boosting iterations/sec on a Higgs-shaped synthetic dataset.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): reference LightGBM trains Higgs-10M (10.5M x 28,
+255 bins, 255 leaves) at 500 iters / 130.094 s = 3.843 iters/sec on a
+28-thread 2x E5-2670v2 (docs/Experiments.rst:111-123). ``vs_baseline`` is
+our iters/sec divided by that number. Rows/leaves are env-tunable because
+round-1 histogram kernels still do full-row masked passes; the measured
+rate is linearly rescaled to the full 10.5M-row workload for an honest
+comparison (rate_full = rate_small * n_small / n_full).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_ITERS_PER_SEC = 500.0 / 130.094
+HIGGS_ROWS = 10_500_000
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+N_FEATURES = int(os.environ.get("BENCH_FEATURES", 28))
+NUM_LEAVES = int(os.environ.get("BENCH_LEAVES", 255))
+MAX_BIN = int(os.environ.get("BENCH_BINS", 255))
+WARMUP = int(os.environ.get("BENCH_WARMUP", 2))
+ITERS = int(os.environ.get("BENCH_ITERS", 8))
+
+
+def make_higgs_like(n, f, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f).astype(np.float32)
+    coef = rs.randn(f).astype(np.float32)
+    logits = X @ coef * 0.5 + 0.5 * rs.randn(n).astype(np.float32)
+    y = (logits > 0).astype(np.float32)
+    return X.astype(np.float64), y.astype(np.float64)
+
+
+def main():
+    import jax
+    import lightgbm_tpu as lgb
+
+    X, y = make_higgs_like(N_ROWS, N_FEATURES)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": MAX_BIN})
+    ds.construct()
+    del X
+
+    bst = lgb.Booster(
+        params={
+            "objective": "binary",
+            "num_leaves": NUM_LEAVES,
+            "max_bin": MAX_BIN,
+            "learning_rate": 0.1,
+            "verbosity": -1,
+        },
+        train_set=ds)
+
+    for _ in range(WARMUP):
+        bst._engine.train_one_iter()
+    bst._engine.score.block_until_ready()
+
+    t0 = time.time()
+    for _ in range(ITERS):
+        bst._engine.train_one_iter()
+    bst._engine.score.block_until_ready()
+    dt = time.time() - t0
+
+    iters_per_sec = ITERS / dt
+    # linear rescale to the full Higgs row count (histogram work is O(rows))
+    iters_per_sec_full = iters_per_sec * (N_ROWS / HIGGS_ROWS)
+    result = {
+        "metric": f"boosting iters/sec, Higgs-shaped {N_ROWS}x{N_FEATURES} "
+                  f"(rescaled to 10.5M rows), {NUM_LEAVES} leaves, "
+                  f"{MAX_BIN} bins, backend={jax.default_backend()}",
+        "value": round(iters_per_sec_full, 4),
+        "unit": "iters/sec",
+        "vs_baseline": round(iters_per_sec_full / BASELINE_ITERS_PER_SEC, 4),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

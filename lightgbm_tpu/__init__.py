@@ -1,0 +1,45 @@
+"""lightgbm_tpu: a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch re-design of the LightGBM feature set
+(reference: /root/reference, PieterPel/LightGBM @ 4.6.0.99) on JAX/XLA:
+histogram-based leaf-wise GBDT with the binned data, gradients and
+histograms resident in HBM; collectives over a `jax.sharding.Mesh`
+instead of sockets/MPI; and a drop-in `Dataset`/`Booster`/`train` Python
+API mirroring the reference python-package.
+"""
+
+from .basic import Booster, Dataset, LightGBMError
+from .callback import (EarlyStopException, early_stopping, log_evaluation,
+                       record_evaluation, reset_parameter)
+from .config import Config
+from .engine import CVBooster, cv, train
+from .utils.log import register_logger
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dataset", "Booster", "CVBooster", "LightGBMError",
+    "train", "cv",
+    "early_stopping", "log_evaluation", "record_evaluation",
+    "reset_parameter", "EarlyStopException",
+    "register_logger", "Config",
+]
+
+try:  # sklearn-style wrappers are optional (need scikit-learn)
+    from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
+                          LGBMRegressor)
+    __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor",
+                "LGBMRanker"]
+except ImportError:  # pragma: no cover
+    pass
+
+try:
+    from . import plotting
+    from .plotting import (create_tree_digraph, plot_importance,
+                           plot_metric, plot_split_value_histogram,
+                           plot_tree)
+    __all__ += ["plot_importance", "plot_metric",
+                "plot_split_value_histogram", "plot_tree",
+                "create_tree_digraph"]
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,690 @@
+"""Dataset and Booster — the user-facing core API.
+
+Re-design of the reference python-package surface
+(/root/reference/python-package/lightgbm/basic.py: Dataset :1744, Booster
+:3539) fused with the C++ layers it fronts (src/io/dataset.cpp,
+dataset_loader.cpp, metadata.cpp, src/c_api.cpp): there is no C API /
+ctypes boundary here — binning is host numpy, training state is JAX arrays
+in HBM, and the model is numpy trees (models/tree.py).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import warnings
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .config import ALIASES, Config, resolve_params
+from .metrics import create_metrics
+from .objectives import create_objective
+from .ops.binning import BinMapper, BinType, MissingType, bin_values, find_bin
+
+__all__ = ["Dataset", "Booster", "LightGBMError"]
+
+
+class LightGBMError(Exception):
+    """Error class (matches the reference package's exception name)."""
+
+
+def _is_1d(a) -> bool:
+    return hasattr(a, "ndim") and a.ndim == 1
+
+
+def _load_text_file(path: str, cfg: Config
+                    ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray],
+                               Optional[np.ndarray]]:
+    """Parse CSV/TSV/LibSVM into (X, label, weight, group).
+
+    Format auto-detection follows Parser::CreateParser
+    (/root/reference/src/io/parser.cpp): sniff the first lines for tabs,
+    commas, or 'idx:value' pairs. Companion ``<file>.weight`` /
+    ``<file>.query`` files are honored like Metadata::Init
+    (src/io/metadata.cpp).
+    """
+    with open(path, "r") as f:
+        first = f.readline().strip()
+    header = cfg.header
+    sep = None
+    if "\t" in first:
+        sep = "\t"
+    elif "," in first:
+        sep = ","
+    tokens = first.replace(",", " ").replace("\t", " ").split()
+    is_libsvm = any(":" in t for t in tokens[1:])
+
+    label_col = 0
+    lc = str(cfg.label_column)
+    if lc.startswith("name:"):
+        pass  # resolved via header below
+    elif lc != "":
+        label_col = int(lc)
+
+    if is_libsvm:
+        labels, rows = [], []
+        max_idx = -1
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = {}
+                for tok in parts[1:]:
+                    if ":" not in tok:
+                        continue
+                    i, v = tok.split(":")
+                    i = int(i)
+                    row[i] = float(v)
+                    max_idx = max(max_idx, i)
+                rows.append(row)
+        X = np.zeros((len(rows), max_idx + 1))
+        for r, row in enumerate(rows):
+            for i, v in row.items():
+                X[r, i] = v
+        y = np.asarray(labels)
+    else:
+        raw = np.genfromtxt(path, delimiter=sep,
+                            skip_header=1 if header else 0)
+        if raw.ndim == 1:
+            raw = raw[:, None]
+        y = raw[:, label_col].copy()
+        X = np.delete(raw, label_col, axis=1)
+
+    weight = None
+    group = None
+    wfile = path + ".weight"
+    if os.path.exists(wfile):
+        weight = np.loadtxt(wfile)
+    qfile = path + ".query"
+    if os.path.exists(qfile):
+        group = np.loadtxt(qfile).astype(np.int64)
+    return X, y, weight, group
+
+
+def _extract_pandas(data, categorical_feature):
+    """Pandas ingestion: category dtypes -> integer codes (the
+    pandas_categorical path of basic.py _data_from_pandas)."""
+    import pandas as pd
+    feature_name = [str(c) for c in data.columns]
+    cat_cols = []
+    pandas_categorical = []
+    arrs = []
+    for i, col in enumerate(data.columns):
+        s = data[col]
+        if isinstance(s.dtype, pd.CategoricalDtype):
+            cat_cols.append(i)
+            pandas_categorical.append(list(s.cat.categories))
+            codes = s.cat.codes.to_numpy().astype(np.float64)
+            codes[codes < 0] = np.nan
+            arrs.append(codes)
+        else:
+            arrs.append(s.to_numpy(dtype=np.float64, na_value=np.nan))
+    X = np.column_stack(arrs) if arrs else np.zeros((len(data), 0))
+    if categorical_feature in ("auto", None, ""):
+        cat_idx = cat_cols
+    else:
+        cat_idx = _resolve_cat_indices(categorical_feature, feature_name)
+    return X, feature_name, cat_idx, pandas_categorical
+
+
+def _resolve_cat_indices(categorical_feature, feature_name) -> List[int]:
+    out = []
+    for c in categorical_feature or []:
+        if isinstance(c, str):
+            if c in feature_name:
+                out.append(feature_name.index(c))
+            else:
+                raise LightGBMError(f"Unknown categorical feature {c}")
+        else:
+            out.append(int(c))
+    return sorted(set(out))
+
+
+class Dataset:
+    """Binned training data container (Dataset + Metadata + DatasetLoader
+    analog: dataset.h:48-555, dataset_loader.cpp)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, position=None):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.position = position
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = resolve_params(params)
+        self.free_raw_data = free_raw_data
+        self._handle = None  # "constructed" flag
+        # constructed state
+        self.mappers: List[BinMapper] = []
+        self._bins: Optional[np.ndarray] = None       # [n, F_used]
+        self._used_features: Optional[np.ndarray] = None
+        self._device_bins = None
+        self._feature_names: List[str] = []
+        self._pandas_categorical = None
+        self._n: int = 0
+        self._F: int = 0
+        self._query_boundaries: Optional[np.ndarray] = None
+        self.used_indices = None
+
+    # -- construction ---------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._handle is not None:
+            return self
+        cfg = Config.from_params(self.params)
+        data = self.data
+        label = self.label
+        weight = self.weight
+        group = self.group
+
+        cat_idx: List[int] = []
+        feature_name = self.feature_name
+        if isinstance(data, (str, Path)):
+            X, y, w, q = _load_text_file(str(data), cfg)
+            if label is None:
+                label = y
+            if weight is None and w is not None:
+                weight = w
+            if group is None and q is not None:
+                group = q
+        else:
+            try:
+                import pandas as pd
+                is_pandas = isinstance(data, pd.DataFrame)
+            except ImportError:
+                is_pandas = False
+            if is_pandas:
+                X, names, cat_idx, self._pandas_categorical = _extract_pandas(
+                    data, self.categorical_feature)
+                if feature_name == "auto":
+                    feature_name = names
+                try:
+                    import pandas as pd
+                    if isinstance(label, (pd.Series, pd.DataFrame)):
+                        label = label.to_numpy().ravel()
+                except ImportError:
+                    pass
+            elif hasattr(data, "tocsr") or hasattr(data, "toarray"):
+                X = np.asarray(data.todense(), dtype=np.float64)
+            elif isinstance(data, np.ndarray):
+                X = np.asarray(data, dtype=np.float64)
+                if X.ndim == 1:
+                    X = X[:, None]
+            elif isinstance(data, (list, tuple)):
+                X = np.asarray(data, dtype=np.float64)
+            else:
+                raise LightGBMError(
+                    f"Cannot construct Dataset from {type(data)}")
+
+        if label is None:
+            raise LightGBMError("Label should not be None")
+        y = np.asarray(label, dtype=np.float64).ravel()
+        n, F = X.shape
+        if len(y) != n:
+            raise LightGBMError(
+                f"Length of label ({len(y)}) != number of rows ({n})")
+        self._n, self._F_total = n, F
+
+        if not isinstance(feature_name, list) or feature_name == "auto":
+            feature_name = [f"Column_{i}" for i in range(F)]
+        self._feature_names = list(feature_name)
+
+        if not cat_idx and self.categorical_feature not in ("auto", None, ""):
+            cat_idx = _resolve_cat_indices(self.categorical_feature,
+                                           self._feature_names)
+        cat_param = cfg.categorical_feature
+        if not cat_idx and cat_param not in ("auto", "", None):
+            if isinstance(cat_param, str):
+                cat_param = [c for c in cat_param.split(",") if c]
+            cat_idx = _resolve_cat_indices(cat_param, self._feature_names)
+        self._cat_idx = set(cat_idx)
+
+        # -- binning: reuse the reference dataset's mappers for alignment
+        # (LoadFromFileAlignWithOtherDataset, dataset_loader.cpp:299) --
+        if self.reference is not None:
+            ref = self.reference.construct()
+            self.mappers = ref.mappers
+            self._used_features = ref._used_features
+            self._feature_names = ref._feature_names
+            full_mappers = ref._full_mappers
+        else:
+            max_bin = cfg.max_bin
+            sample_cnt = min(cfg.bin_construct_sample_cnt, n)
+            if sample_cnt < n:
+                rng = np.random.RandomState(cfg.data_random_seed)
+                sample_rows = rng.choice(n, size=sample_cnt, replace=False)
+            else:
+                sample_rows = slice(None)
+            full_mappers = []
+            for j in range(F):
+                mb = max_bin
+                if cfg.max_bin_by_feature and j < len(cfg.max_bin_by_feature):
+                    mb = cfg.max_bin_by_feature[j]
+                m = find_bin(
+                    X[sample_rows, j], mb,
+                    min_data_in_bin=cfg.min_data_in_bin,
+                    bin_type=(BinType.CATEGORICAL if j in self._cat_idx
+                              else BinType.NUMERICAL),
+                    use_missing=cfg.use_missing,
+                    zero_as_missing=cfg.zero_as_missing)
+                full_mappers.append(m)
+            used = [j for j, m in enumerate(full_mappers) if not m.is_trivial]
+            self._used_features = np.asarray(used, dtype=np.int32)
+            self.mappers = [full_mappers[j] for j in used]
+        self._full_mappers = full_mappers
+
+        cols = [X[:, j] for j in self._used_features]
+        self._bins = bin_values(cols, self.mappers)
+        self._F = len(self.mappers)
+
+        self.label = y
+        self.weight = None if weight is None else \
+            np.asarray(weight, np.float64).ravel()
+        if group is not None:
+            g = np.asarray(group, np.int64).ravel()
+            self._query_boundaries = np.concatenate(
+                [[0], np.cumsum(g)]).astype(np.int64)
+            if self._query_boundaries[-1] != n:
+                raise LightGBMError(
+                    "Sum of group sizes != number of rows")
+        if self.init_score is not None:
+            self.init_score = np.asarray(self.init_score,
+                                         np.float64)
+        self._handle = True
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    # -- introspection ---------------------------------------------------
+    def num_data(self) -> int:
+        self.construct()
+        return self._n
+
+    def num_features(self) -> int:
+        """Number of *usable* (non-trivial) features."""
+        self.construct()
+        return self._F
+
+    def num_total_features(self) -> int:
+        self.construct()
+        return self._F_total
+
+    def num_total_bins(self) -> int:
+        self.construct()
+        return max((m.num_bins for m in self.mappers), default=2)
+
+    def get_feature_name(self) -> List[str]:
+        self.construct()
+        return list(self._feature_names)
+
+    def get_label(self):
+        return self.label
+
+    def get_weight(self):
+        return self.weight
+
+    def get_init_score(self):
+        return self.init_score
+
+    def get_group(self):
+        if self._query_boundaries is None:
+            return None
+        return np.diff(self._query_boundaries)
+
+    def query_boundaries(self) -> Optional[np.ndarray]:
+        self.construct()
+        return self._query_boundaries
+
+    def set_label(self, label) -> "Dataset":
+        self.label = np.asarray(label, np.float64).ravel()
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = None if weight is None else \
+            np.asarray(weight, np.float64).ravel()
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        g = np.asarray(group, np.int64).ravel()
+        self._query_boundaries = np.concatenate(
+            [[0], np.cumsum(g)]).astype(np.int64)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = None if init_score is None else \
+            np.asarray(init_score, np.float64)
+        return self
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None, position=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params or self.params, position=position)
+
+    # -- device views ----------------------------------------------------
+    def device_bins(self):
+        """[F, n] bin matrix on device (feature-major; HBM-resident)."""
+        import jax.numpy as jnp
+        self.construct()
+        if self._device_bins is None:
+            self._device_bins = jnp.asarray(self._bins.T)
+        return self._device_bins
+
+    def host_bins(self) -> np.ndarray:
+        self.construct()
+        return self._bins
+
+    def device_feat_num_bins(self):
+        import jax.numpy as jnp
+        self.construct()
+        return jnp.asarray([m.num_bins for m in self.mappers], jnp.int32)
+
+    def device_feat_nan_bin(self):
+        import jax.numpy as jnp
+        self.construct()
+        # The "missing bin" per feature: rows landing in it are routed by
+        # the learned default direction, not the threshold. NaN features
+        # keep it as the last bin; zero_as_missing features use the zero
+        # bin (which may sit mid-range).
+        nb = []
+        for m in self.mappers:
+            if m.bin_type != BinType.NUMERICAL:
+                nb.append(-1)
+            elif m.missing_type == MissingType.NAN:
+                nb.append(m.num_bins - 1)
+            elif m.missing_type == MissingType.ZERO:
+                nb.append(m.default_bin)
+            else:
+                nb.append(-1)
+        return jnp.asarray(nb, jnp.int32)
+
+    def used_feature_indices(self) -> np.ndarray:
+        self.construct()
+        return self._used_features
+
+    def usable_feature_mask(self) -> np.ndarray:
+        self.construct()
+        return np.ones((self._F,), bool)
+
+    def inner_feature_index(self, real_idx: np.ndarray) -> np.ndarray:
+        """Map real feature indices to positions in the used-feature set."""
+        self.construct()
+        lut = np.full((self._F_total,), -1, np.int32)
+        lut[self._used_features] = np.arange(self._F, dtype=np.int32)
+        return lut[np.asarray(real_idx, np.int64)]
+
+    def thresholds_to_bins(self, real_feat: np.ndarray,
+                           thresholds: np.ndarray) -> np.ndarray:
+        self.construct()
+        inner = self.inner_feature_index(real_feat)
+        out = np.zeros(len(thresholds), np.int32)
+        for i, (f, t) in enumerate(zip(inner, thresholds)):
+            m = self.mappers[f]
+            out[i] = int(np.searchsorted(m.upper_bounds, t, side="left"))
+        return out
+
+    def monotone_array(self, cfg: Config) -> Optional[np.ndarray]:
+        mc = cfg.monotone_constraints
+        if not mc:
+            return None
+        self.construct()
+        full = np.zeros((self._F_total,), np.int8)
+        full[: len(mc)] = mc
+        return full[self._used_features]
+
+    def feature_infos(self) -> List[str]:
+        self.construct()
+        out = []
+        lut = {int(j): m for j, m in zip(self._used_features, self.mappers)}
+        for j in range(self._F_total):
+            m = lut.get(j)
+            if m is None:
+                out.append("none")
+            elif m.bin_type == BinType.CATEGORICAL:
+                out.append(":".join(str(int(c)) for c in m.bin_to_cat))
+            else:
+                out.append(f"[{m.min_value:g}:{m.max_value:g}]")
+        return out
+
+
+class _EvalResultTuple(tuple):
+    pass
+
+
+class Booster:
+    """User-facing booster (basic.py:3539 Booster analog)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._train_data_name = "training"
+        self.params = params or {}
+        self._engine = None
+        self._metrics = []
+        self._valid_names: List[str] = []
+        self.pandas_categorical = None
+        self._trees: List = []
+        self._cfg: Optional[Config] = None
+        self._num_class = 1
+        self._feature_names: List[str] = []
+        self._feature_infos: List[str] = []
+        self._objective_str = "none"
+        self._avg_output = False
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("Training data should be a Dataset instance")
+            cfg = Config.from_params(params)
+            train_set.params = {**resolve_params(train_set.params),
+                               **resolve_params(params)}
+            train_set.construct()
+            self._cfg = cfg
+            objective = create_objective(cfg)
+            if objective is not None and hasattr(objective, "set_dataset"):
+                objective.set_dataset(train_set)
+            from .models.gbdt import GBDTBooster
+            self._engine = GBDTBooster(cfg, train_set, objective)
+            self._metrics = create_metrics(cfg)
+            self._num_class = cfg.num_class
+            self._feature_names = train_set.get_feature_name()
+            self._feature_infos = train_set.feature_infos()
+            self._objective_str = self._objective_repr(cfg)
+            self._avg_output = cfg.boosting == "rf"
+            self.train_set = train_set
+        elif model_file is not None:
+            with open(model_file) as f:
+                self._load_model_string(f.read())
+        elif model_str is not None:
+            self._load_model_string(model_str)
+        else:
+            raise TypeError(
+                "At least one of train_set, model_file or model_str "
+                "should be not None")
+
+    # -- training --------------------------------------------------------
+    @property
+    def _models(self) -> List:
+        return self._engine.models if self._engine is not None \
+            else self._trees
+
+    def _objective_repr(self, cfg: Config) -> str:
+        o = cfg.objective
+        if o == "binary":
+            return f"binary sigmoid:{cfg.sigmoid:g}"
+        if o in ("multiclass", "multiclassova"):
+            return f"{o} num_class:{cfg.num_class}"
+        if o == "lambdarank":
+            return "lambdarank"
+        return o
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.construct()
+        self._engine.add_valid(data, name)
+        self._valid_names.append(name)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; True means training should stop
+        (no further splits possible)."""
+        if train_set is not None:
+            raise LightGBMError(
+                "Resetting train_set mid-training is not supported yet")
+        if fobj is not None:
+            import numpy as _np
+            score = self._engine.current_score(0)
+            K = self._engine.K
+            grad, hess = fobj(score[0] if K == 1 else score,
+                              self._engine.train_set)
+            return self._engine.train_one_iter(
+                _np.asarray(grad), _np.asarray(hess))
+        return self._engine.train_one_iter()
+
+    def rollback_one_iter(self) -> "Booster":
+        self._engine.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        return len(self._models) // self.num_model_per_iteration()
+
+    def num_trees(self) -> int:
+        return len(self._models)
+
+    def num_model_per_iteration(self) -> int:
+        if self._engine is not None:
+            return self._engine.K
+        return max(1, self._num_class)
+
+    def num_feature(self) -> int:
+        if self._engine is not None:
+            return self._engine.train_set.num_total_features()
+        return len(self._feature_names)
+
+    def feature_name(self) -> List[str]:
+        return list(self._feature_names)
+
+    # -- evaluation -------------------------------------------------------
+    def eval_train(self, feval=None) -> List[Tuple]:
+        return self._eval(0, self._train_data_name, feval)
+
+    def eval_valid(self, feval=None) -> List[Tuple]:
+        out = []
+        for i, name in enumerate(self._valid_names):
+            out.extend(self._eval(i + 1, name, feval))
+        return out
+
+    def eval(self, data, name: str, feval=None) -> List[Tuple]:
+        if data is self.train_set:
+            return self._eval(0, self._train_data_name, feval)
+        for i, v in enumerate(self._engine.valid_sets):
+            if v.dataset is data:
+                return self._eval(i + 1, name, feval)
+        raise LightGBMError("Data should be added with add_valid first")
+
+    def _eval(self, data_idx: int, name: str, feval=None) -> List[Tuple]:
+        res = self._engine.eval_metrics(self._metrics, data_idx)
+        out = [(name, mname, val, self._metric_higher_better(mname))
+               for mname, val in res.items()]
+        if feval is not None:
+            fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+            score = self._engine.current_score(data_idx)
+            ds = self._engine.train_set if data_idx == 0 else \
+                self._engine.valid_sets[data_idx - 1].dataset
+            for f in fevals:
+                ret = f(score[0] if self._engine.K == 1 else score, ds)
+                if isinstance(ret, list):
+                    for (mn, v, hb) in ret:
+                        out.append((name, mn, v, hb))
+                else:
+                    mn, v, hb = ret
+                    out.append((name, mn, v, hb))
+        return out
+
+    def _metric_higher_better(self, mname: str) -> bool:
+        for m in self._metrics:
+            if m.name == mname:
+                return m.higher_better
+        return False
+
+    # -- prediction --------------------------------------------------------
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, validate_features: bool = False,
+                **kwargs) -> np.ndarray:
+        from .prediction import predict_any
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration > 0 \
+                else -1
+        return predict_any(self, data, start_iteration, num_iteration,
+                           raw_score, pred_leaf, pred_contrib)
+
+    # -- model io ----------------------------------------------------------
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        from .models.model_io import model_to_string
+        return model_to_string(self, num_iteration, start_iteration,
+                               importance_type)
+
+    def save_model(self, filename, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> "Booster":
+        s = self.model_to_string(num_iteration, start_iteration,
+                                 importance_type)
+        with open(filename, "w") as f:
+            f.write(s)
+        return self
+
+    def _load_model_string(self, s: str) -> None:
+        from .models.model_io import load_model_string
+        load_model_string(self, s)
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> Dict:
+        from .models.model_io import dump_model_dict
+        return dump_model_dict(self, num_iteration, start_iteration,
+                               importance_type)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        nf = self.num_feature()
+        imp = np.zeros((nf,), np.float64)
+        trees = self._models
+        if iteration is not None and iteration > 0:
+            trees = trees[: iteration * self.num_model_per_iteration()]
+        for t in trees:
+            for i in range(t.num_nodes):
+                f = int(t.split_feature[i])
+                if importance_type == "split":
+                    imp[f] += 1
+                else:
+                    imp[f] += max(0.0, float(t.split_gain[i]))
+        if importance_type == "split":
+            return imp.astype(np.int64 if True else np.float64)
+        return imp
+
+    def trees_to_dataframe(self):
+        from .models.model_io import trees_to_dataframe
+        return trees_to_dataframe(self)
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, memo):
+        model_str = self.model_to_string()
+        return Booster(model_str=model_str)

@@ -1,0 +1,255 @@
+"""Training callbacks.
+
+Re-design of /root/reference/python-package/lightgbm/callback.py:
+``log_evaluation`` (:109), ``record_evaluation`` (:183),
+``reset_parameter`` (:254), ``early_stopping`` (:454 /
+``_EarlyStoppingCallback`` :278). The callback protocol (CallbackEnv,
+before/after ordering, EarlyStopException unwinding) matches the
+reference so user callbacks port unchanged.
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from .utils.log import log_info, log_warning
+
+__all__ = ["EarlyStopException", "CallbackEnv", "log_evaluation",
+           "record_evaluation", "reset_parameter", "early_stopping"]
+
+
+class EarlyStopException(Exception):
+    def __init__(self, best_iteration: int, best_score):
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+def _fmt_eval(res: Tuple) -> str:
+    if len(res) == 4:
+        return f"{res[0]}'s {res[1]}: {res[2]:g}"
+    return f"{res[0]}'s {res[1]}: {res[2]:g} + {res[4]:g}"
+
+
+class _LogEvaluationCallback:
+    order = 10
+
+    def __init__(self, period: int = 1, show_stdv: bool = True):
+        self.period = period
+        self.show_stdv = show_stdv
+        self.before_iteration = False
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.period > 0 and env.evaluation_result_list \
+                and (env.iteration + 1) % self.period == 0:
+            result = "\t".join(
+                _fmt_eval(x) for x in env.evaluation_result_list)
+            log_info(f"[{env.iteration + 1}]\t{result}")
+
+
+def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    return _LogEvaluationCallback(period=period, show_stdv=show_stdv)
+
+
+class _RecordEvaluationCallback:
+    order = 20
+
+    def __init__(self, eval_result: Dict):
+        if not isinstance(eval_result, dict):
+            raise TypeError("eval_result should be a dictionary")
+        self.eval_result = eval_result
+        self.before_iteration = False
+
+    def _init(self, env: CallbackEnv) -> None:
+        self.eval_result.clear()
+        for item in env.evaluation_result_list:
+            data_name, eval_name = item[0], item[1]
+            self.eval_result.setdefault(data_name, collections.OrderedDict())
+            if len(item) == 4:
+                self.eval_result[data_name].setdefault(eval_name, [])
+            else:
+                self.eval_result[data_name].setdefault(eval_name, [])
+                self.eval_result[data_name].setdefault(
+                    f"{eval_name}-stdv", [])
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if env.iteration == env.begin_iteration:
+            self._init(env)
+        for item in env.evaluation_result_list:
+            if len(item) == 4:
+                data_name, eval_name, result = item[:3]
+                self.eval_result[data_name][eval_name].append(result)
+            else:
+                data_name, eval_name, result, _, stdv = item
+                self.eval_result[data_name][eval_name].append(result)
+                self.eval_result[data_name][f"{eval_name}-stdv"].append(stdv)
+
+
+def record_evaluation(eval_result: Dict) -> Callable:
+    return _RecordEvaluationCallback(eval_result)
+
+
+class _ResetParameterCallback:
+    order = 10
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        self.before_iteration = True
+
+    def __call__(self, env: CallbackEnv) -> None:
+        new_parameters = {}
+        for key, value in self.kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        f"Length of list {key!r} has to equal to "
+                        "'num_boost_round'.")
+                new_param = value[env.iteration - env.begin_iteration]
+            elif callable(value):
+                new_param = value(env.iteration - env.begin_iteration)
+            else:
+                raise ValueError("Only list and callable values are "
+                                 "supported as a mapping from boosting "
+                                 "round index to new parameter value.")
+            if new_param != env.params.get(key, None):
+                new_parameters[key] = new_param
+        if new_parameters:
+            if "learning_rate" in new_parameters and env.model is not None:
+                env.model._engine._shrinkage = \
+                    new_parameters["learning_rate"]
+            env.params.update(new_parameters)
+
+
+def reset_parameter(**kwargs) -> Callable:
+    return _ResetParameterCallback(**kwargs)
+
+
+class _EarlyStoppingCallback:
+    """Early stopping on validation metrics (callback.py:278)."""
+
+    order = 30
+
+    def __init__(self, stopping_rounds: int, first_metric_only: bool = False,
+                 verbose: bool = True,
+                 min_delta: Union[float, List[float]] = 0.0):
+        if stopping_rounds <= 0:
+            raise ValueError("stopping_rounds should be greater than zero.")
+        self.stopping_rounds = stopping_rounds
+        self.first_metric_only = first_metric_only
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.before_iteration = False
+        self.enabled = True
+        self._reset_storages()
+
+    def _reset_storages(self) -> None:
+        self.best_score: List[float] = []
+        self.best_iter: List[int] = []
+        self.best_score_list: List[Any] = []
+        self.cmp_op: List[Callable[[float, float], bool]] = []
+        self.first_metric = ""
+
+    def _init(self, env: CallbackEnv) -> None:
+        self._reset_storages()
+        if not env.evaluation_result_list:
+            raise ValueError(
+                "For early stopping, at least one dataset and eval metric "
+                "is required for evaluation")
+        n_metrics = len({m[1] for m in env.evaluation_result_list})
+        n_datasets = len(env.evaluation_result_list) // max(n_metrics, 1)
+        if isinstance(self.min_delta, list):
+            if len(self.min_delta) != n_metrics:
+                raise ValueError(
+                    "Must provide a single value for min_delta or as many "
+                    "as metrics.")
+            if self.first_metric_only and self.verbose:
+                log_info(f"Using only {self.min_delta[0]} as early "
+                         "stopping min_delta.")
+            deltas = self.min_delta * n_datasets
+        else:
+            if self.min_delta < 0:
+                raise ValueError("Early stopping min_delta must be "
+                                 "non-negative.")
+            deltas = [self.min_delta] * n_datasets * n_metrics
+        self.first_metric = env.evaluation_result_list[0][1]
+        for eval_ret, delta in zip(env.evaluation_result_list, deltas):
+            self.best_iter.append(0)
+            if eval_ret[3]:  # higher is better
+                self.best_score.append(float("-inf"))
+                self.cmp_op.append(partial(self._gt_delta, delta=delta))
+            else:
+                self.best_score.append(float("inf"))
+                self.cmp_op.append(partial(self._lt_delta, delta=delta))
+            self.best_score_list.append(None)
+
+    @staticmethod
+    def _gt_delta(curr: float, best: float, delta: float) -> bool:
+        return curr > best + delta
+
+    @staticmethod
+    def _lt_delta(curr: float, best: float, delta: float) -> bool:
+        return curr < best - delta
+
+    def _final_iteration_check(self, env, eval_name_splitted, i) -> None:
+        if env.iteration == env.end_iteration - 1:
+            if self.verbose:
+                best = "\t".join(
+                    _fmt_eval(x) for x in self.best_score_list[i])
+                log_info("Did not meet early stopping. Best iteration is:"
+                         f"\n[{self.best_iter[i] + 1}]\t{best}")
+                if self.first_metric_only:
+                    log_info(f"Evaluated only: {eval_name_splitted[-1]}")
+            raise EarlyStopException(self.best_iter[i],
+                                     self.best_score_list[i])
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if env.iteration == env.begin_iteration:
+            self._init(env)
+        if not self.enabled:
+            return
+        for i in range(len(env.evaluation_result_list)):
+            score = env.evaluation_result_list[i][2]
+            if self.best_score_list[i] is None \
+                    or self.cmp_op[i](score, self.best_score[i]):
+                self.best_score[i] = score
+                self.best_iter[i] = env.iteration
+                self.best_score_list[i] = env.evaluation_result_list
+            eval_name_splitted = env.evaluation_result_list[i][1].split(" ")
+            if self.first_metric_only \
+                    and self.first_metric != eval_name_splitted[-1]:
+                continue
+            if env.evaluation_result_list[i][0] == "cv_agg" \
+                    and eval_name_splitted[0] == "train":
+                continue
+            if env.model is not None and env.evaluation_result_list[i][0] \
+                    == env.model._train_data_name:
+                continue
+            if env.iteration - self.best_iter[i] >= self.stopping_rounds:
+                if self.verbose:
+                    best = "\t".join(
+                        _fmt_eval(x) for x in self.best_score_list[i])
+                    log_info("Early stopping, best iteration is:"
+                             f"\n[{self.best_iter[i] + 1}]\t{best}")
+                    if self.first_metric_only:
+                        log_info(
+                            f"Evaluated only: {eval_name_splitted[-1]}")
+                raise EarlyStopException(self.best_iter[i],
+                                         self.best_score_list[i])
+            self._final_iteration_check(env, eval_name_splitted, i)
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True,
+                   min_delta: Union[float, List[float]] = 0.0) -> Callable:
+    return _EarlyStoppingCallback(stopping_rounds=stopping_rounds,
+                                  first_metric_only=first_metric_only,
+                                  verbose=verbose, min_delta=min_delta)

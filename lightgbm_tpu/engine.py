@@ -1,0 +1,341 @@
+"""Training/CV entry points.
+
+Re-design of /root/reference/python-package/lightgbm/engine.py:
+``train`` (:109, iteration loop :309-322), ``cv`` (:625), ``CVBooster``
+(:354). Callback ordering, early-stopping unwinding and best_iteration
+bookkeeping match the reference semantics.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset, LightGBMError
+from .config import Config, resolve_params
+from .utils.log import log_info, log_warning
+
+__all__ = ["train", "cv", "CVBooster"]
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          feval: Optional[Union[Callable, List[Callable]]] = None,
+          init_model: Optional[Union[str, Booster]] = None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None,
+          fobj: Optional[Callable] = None) -> Booster:
+    """Train one model (engine.py:109 analog)."""
+    params = resolve_params(params)
+    # num_boost_round from params wins (alias resolution)
+    if "num_iterations" in params:
+        num_boost_round = int(params["num_iterations"])
+    params["num_iterations"] = num_boost_round
+    cfg = Config.from_params(params)
+    if cfg.objective == "custom" and fobj is None:
+        raise LightGBMError(
+            "objective=none requires a custom objective function (fobj)")
+
+    if init_model is not None:
+        raise LightGBMError(
+            "Continued training (init_model) is not supported yet")
+
+    if not isinstance(train_set, Dataset):
+        raise TypeError("train() only accepts Dataset object(s)")
+
+    booster = Booster(params=params, train_set=train_set)
+    valid_sets = valid_sets or []
+    is_valid_contain_train = False
+    train_data_name = "training"
+    name_list = []
+    for i, vd in enumerate(valid_sets):
+        if valid_names is not None and i < len(valid_names):
+            name = valid_names[i]
+        else:
+            name = f"valid_{i}"
+        if vd is train_set:
+            is_valid_contain_train = True
+            train_data_name = name
+            booster._train_data_name = name
+            continue
+        vd.construct()
+        booster.add_valid(vd, name)
+        name_list.append(name)
+
+    # callbacks setup (before/after split, ordering by .order)
+    callbacks = list(callbacks) if callbacks else []
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        callbacks.append(callback_mod.early_stopping(
+            cfg.early_stopping_round,
+            first_metric_only=cfg.first_metric_only,
+            min_delta=cfg.early_stopping_min_delta,
+            verbose=cfg.verbosity >= 1))
+    if cfg.verbosity >= 1 and cfg.is_provide_training_metric:
+        pass  # training metric printed through evaluation list below
+    cbs_before = {cb for cb in callbacks
+                  if getattr(cb, "before_iteration", False)}
+    cbs_after = [cb for cb in callbacks if cb not in cbs_before]
+    cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
+    cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
+
+    begin_iteration = 0
+    evaluation_result_list: List[Tuple] = []
+    for i in range(begin_iteration, begin_iteration + num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=begin_iteration,
+                end_iteration=begin_iteration + num_boost_round,
+                evaluation_result_list=None))
+        finished = booster.update(fobj=fobj)
+
+        evaluation_result_list = []
+        if (i + 1) % max(1, cfg.metric_freq) == 0 or \
+                i == begin_iteration + num_boost_round - 1:
+            if valid_sets or is_valid_contain_train:
+                if is_valid_contain_train:
+                    evaluation_result_list.extend(booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=begin_iteration,
+                    end_iteration=begin_iteration + num_boost_round,
+                    evaluation_result_list=evaluation_result_list))
+        except callback_mod.EarlyStopException as es:
+            booster.best_iteration = es.best_iteration + 1
+            evaluation_result_list = es.best_score
+            # roll the model back to best_iteration for storage parity
+            break
+        if finished:
+            log_info("Stopped training because there are no more leaves "
+                     "that meet the split requirements")
+            break
+
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration()
+    for item in (evaluation_result_list or []):
+        booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (engine.py:354)."""
+
+    def __init__(self, model_file: Optional[str] = None):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name: str):
+        def handler_function(*args: Any, **kwargs: Any) -> List[Any]:
+            return [getattr(b, name)(*args, **kwargs)
+                    for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params: Dict,
+                  seed: int, stratified: bool, shuffle: bool):
+    full_data.construct()
+    num_data = full_data.num_data()
+    label = np.asarray(full_data.get_label())
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and not hasattr(folds, "split"):
+            raise AttributeError(
+                "folds should be a generator or iterator of (train_idx, "
+                "test_idx) tuples or scikit-learn splitter object")
+        if hasattr(folds, "split"):
+            group = full_data.get_group()
+            group_info = None if group is None else np.asarray(group)
+            flatted_group = np.zeros(num_data, dtype=np.int64)
+            if group_info is not None:
+                flatted_group = np.repeat(range(len(group_info)), group_info)
+            folds = folds.split(X=np.empty(num_data), y=label,
+                                groups=flatted_group)
+        return list(folds)
+    rng = np.random.RandomState(seed)
+    if full_data.get_group() is not None:
+        # group-aware folds: whole queries per fold
+        group = np.asarray(full_data.get_group())
+        nq = len(group)
+        q_idx = np.arange(nq)
+        if shuffle:
+            rng.shuffle(q_idx)
+        q_fold = np.arange(nq) % nfold
+        row_fold = np.zeros(num_data, np.int64)
+        starts = np.concatenate([[0], np.cumsum(group)])
+        for qi, f in zip(q_idx, q_fold):
+            row_fold[starts[qi]:starts[qi + 1]] = f
+        return [(np.where(row_fold != f)[0], np.where(row_fold == f)[0])
+                for f in range(nfold)]
+    if stratified:
+        # label-sorted striping keeps class ratios per fold; with shuffle,
+        # rows are permuted within each label block first so fold
+        # membership is random rather than row-order-determined
+        order = np.argsort(label, kind="stable")
+        if shuffle:
+            sorted_labels = label[order]
+            block_starts = np.concatenate(
+                [[0], np.where(np.diff(sorted_labels) != 0)[0] + 1,
+                 [num_data]])
+            for a, b in zip(block_starts[:-1], block_starts[1:]):
+                perm = rng.permutation(b - a)
+                order[a:b] = order[a:b][perm]
+        fold_of = np.empty(num_data, np.int64)
+        fold_of[order] = np.arange(num_data) % nfold
+        return [(np.where(fold_of != f)[0], np.where(fold_of == f)[0])
+                for f in range(nfold)]
+    idx = np.arange(num_data)
+    if shuffle:
+        rng.shuffle(idx)
+    return [(np.concatenate([idx[: (f * num_data) // nfold],
+                             idx[((f + 1) * num_data) // nfold:]]),
+             idx[(f * num_data) // nfold: ((f + 1) * num_data) // nfold])
+            for f in range(nfold)]
+
+
+def _agg_cv_result(raw_results: List[List[Tuple]]):
+    cvmap: Dict[str, List[float]] = {}
+    metric_type: Dict[str, bool] = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            key = f"{one_line[0]} {one_line[1]}"
+            metric_type[key] = one_line[3]
+            cvmap.setdefault(key, [])
+            cvmap[key].append(one_line[2])
+    return [("cv_agg", k, float(np.mean(v)), metric_type[k],
+             float(np.std(v))) for k, v in cvmap.items()]
+
+
+def cv(params: Dict[str, Any], train_set: Dataset,
+       num_boost_round: int = 100, folds=None, nfold: int = 5,
+       stratified: bool = True, shuffle: bool = True,
+       metrics=None, feval=None, init_model=None,
+       fpreproc=None, seed: int = 0, callbacks=None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, Any]:
+    """K-fold cross validation (engine.py:625 analog)."""
+    if not isinstance(train_set, Dataset):
+        raise TypeError("cv() only accepts Dataset object(s)")
+    params = resolve_params(params)
+    if "num_iterations" in params:
+        num_boost_round = int(params["num_iterations"])
+    if metrics is not None:
+        params["metric"] = metrics
+    cfg = Config.from_params(params)
+    if cfg.objective in ("binary", "multiclass", "multiclassova",
+                         "lambdarank", "rank_xendcg"):
+        stratified = stratified and cfg.objective == "binary"
+    else:
+        stratified = False
+
+    train_set.construct()
+    folds = _make_n_folds(train_set, folds, nfold, params, seed,
+                          stratified, shuffle)
+    label = np.asarray(train_set.get_label())
+    weight = train_set.get_weight()
+    group = train_set.get_group()
+    # raw feature matrix must still be around for fold slicing
+    X = train_set.host_bins()  # binned is fine: folds share bin mappers
+
+    cvbooster = CVBooster()
+    results: Dict[str, List[float]] = {}
+
+    boosters = []
+    for train_idx, test_idx in folds:
+        tr = _subset_dataset(train_set, train_idx, params)
+        te = _subset_dataset(train_set, test_idx, params)
+        if fpreproc is not None:
+            tr, te, params = fpreproc(tr, te, params.copy())
+        booster = Booster(params=params, train_set=tr)
+        booster.add_valid(te, "valid")
+        if eval_train_metric:
+            booster._train_data_name = "train"
+        boosters.append(booster)
+        cvbooster._append(booster)
+
+    callbacks = list(callbacks) if callbacks else []
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        callbacks.append(callback_mod.early_stopping(
+            cfg.early_stopping_round,
+            first_metric_only=cfg.first_metric_only,
+            verbose=cfg.verbosity >= 1))
+    cbs_before = sorted((cb for cb in callbacks
+                         if getattr(cb, "before_iteration", False)),
+                        key=lambda c: getattr(c, "order", 0))
+    cbs_after = sorted((cb for cb in callbacks
+                        if not getattr(cb, "before_iteration", False)),
+                       key=lambda c: getattr(c, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in cbs_before:
+            cb(callback_mod.CallbackEnv(
+                model=cvbooster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=None))
+        for booster in boosters:
+            booster.update()
+        raw = []
+        for booster in boosters:
+            one = []
+            if eval_train_metric:
+                one.extend(booster.eval_train(feval))
+            one.extend(booster.eval_valid(feval))
+            raw.append(one)
+        res = _agg_cv_result(raw)
+        for (_, key, mean, _, std) in res:
+            results.setdefault(f"{key}-mean", []).append(mean)
+            results.setdefault(f"{key}-stdv", []).append(std)
+        try:
+            for cb in cbs_after:
+                cb(callback_mod.CallbackEnv(
+                    model=cvbooster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=res))
+        except callback_mod.EarlyStopException as es:
+            cvbooster.best_iteration = es.best_iteration + 1
+            for bst in boosters:
+                bst.best_iteration = cvbooster.best_iteration
+            for k in results:
+                results[k] = results[k][: cvbooster.best_iteration]
+            break
+
+    if return_cvbooster:
+        results["cvbooster"] = cvbooster
+    return dict(results)
+
+
+def _subset_dataset(full: Dataset, idx: np.ndarray,
+                    params: Dict) -> Dataset:
+    """Row-subset sharing the parent's bin mappers (Dataset::CopySubrow /
+    Subset analog, dataset.h:661)."""
+    full.construct()
+    sub = Dataset.__new__(Dataset)
+    sub.__dict__.update({k: v for k, v in full.__dict__.items()})
+    sub.reference = full
+    sub._bins = full._bins[idx]
+    sub._device_bins = None
+    sub._n = len(idx)
+    sub.label = np.asarray(full.get_label())[idx]
+    w = full.get_weight()
+    sub.weight = None if w is None else np.asarray(w)[idx]
+    init = full.get_init_score()
+    sub.init_score = None if init is None else np.asarray(init)[idx]
+    qb = full.query_boundaries()
+    if qb is not None:
+        # reconstruct boundaries for the kept (whole) queries
+        row_query = np.searchsorted(qb, idx, side="right") - 1
+        kept_q, counts = np.unique(row_query, return_counts=True)
+        sub._query_boundaries = np.concatenate(
+            [[0], np.cumsum(counts)]).astype(np.int64)
+    sub.used_indices = np.asarray(idx)
+    sub._handle = True
+    return sub

@@ -1,0 +1,1 @@
+"""Model structures and boosting drivers."""

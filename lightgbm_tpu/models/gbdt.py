@@ -1,0 +1,573 @@
+"""GBDT boosting driver.
+
+Re-design of /root/reference/src/boosting/gbdt.cpp (Init :53, Train :237,
+TrainOneIter :344, UpdateScore :491, BoostFromAverage :319), dart.hpp,
+rf.hpp, bagging.hpp and goss.hpp for TPU:
+
+- The binned matrix, scores, gradients and the growth loop all live in HBM;
+  only the finished (small) tree arrays cross back to the host per
+  iteration (the CUDA learner's host<->device contract, SURVEY.md §3.5).
+- Bagging and GOSS are expressed as a per-row *weight vector* instead of
+  index compaction (bagging.hpp:30 builds bag_data_indices_): a row's
+  weight multiplies (g, h) and is the unit counted by min_data_in_leaf, so
+  out-of-bag rows simply weigh 0. This keeps every shape static and is
+  mathematically identical to training on the subset.
+- Sampling uses jax.random with a per-iteration folded key -> deterministic
+  and device-resident (no host RNG transfer per iteration).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..objectives import Objective
+from ..ops.grow import GrowConfig, TreeArrays, grow_tree
+from ..ops.predict import predict_leaf_binned
+from ..ops.renew import renew_leaf_values
+from ..ops.split import SplitParams
+from .tree import Tree, tree_from_arrays
+
+__all__ = ["GBDTBooster"]
+
+
+@jax.jit
+def _tree_values_binned(split_feature, threshold_bin, default_left,
+                        left_child, right_child, leaf_value,
+                        feat_nan_bin, bins_T):
+    """Jitted per-row tree output over binned data (compiled once per
+    (num_leaves, n) shape — trees are padded to the configured size)."""
+    leaves = predict_leaf_binned(split_feature, threshold_bin, default_left,
+                                 left_child, right_child, feat_nan_bin,
+                                 bins_T)
+    return leaf_value[leaves]
+
+
+class _ValidData:
+    def __init__(self, dataset, score: jnp.ndarray, name: str):
+        self.dataset = dataset
+        self.score = score
+        self.name = name
+
+
+class GBDTBooster:
+    """The boosting engine behind the public Booster (basic.py)."""
+
+    def __init__(self, cfg: Config, train_set, objective: Optional[Objective],
+                 num_model_per_iter: int = 1):
+        self.cfg = cfg
+        self.train_set = train_set
+        self.objective = objective
+        self.K = (objective.num_model_per_iteration
+                  if objective is not None else num_model_per_iter)
+        self.models: List[Tree] = []
+        self.iter_ = 0
+        self.valid_sets: List[_ValidData] = []
+        self._shrinkage = cfg.learning_rate
+
+        ds = train_set
+        self.n = ds.num_data()
+        self.F = ds.num_features()
+        self.bins_T = ds.device_bins()            # [F, n]
+        self.feat_num_bins = ds.device_feat_num_bins()
+        self.feat_nan_bin = ds.device_feat_nan_bin()
+        self.label = jnp.asarray(ds.get_label(), jnp.float32)
+        w = ds.get_weight()
+        self.weight = None if w is None else jnp.asarray(w, jnp.float32)
+        mono = ds.monotone_array(cfg)
+        self.monotone = None if mono is None else jnp.asarray(mono, jnp.int8)
+
+        # boost_from_average (gbdt.cpp:319). The average is folded into the
+        # first iteration's trees as a leaf-value bias (TrainOneIter's
+        # AddBias path) so saved models are self-contained.
+        # rf: the prior is folded into EVERY tree (rf.hpp AddBias) and the
+        # score is a running average; gbdt/dart: folded into the first
+        # iteration's trees only.
+        init_score = np.zeros((self.K,), np.float64)
+        self._fold_bias = False
+        if objective is not None and cfg.boost_from_average \
+                and ds.get_init_score() is None:
+            self._fold_bias = cfg.boosting != "rf"
+            if hasattr(objective, "init_label_weights"):
+                objective.init_label_weights(np.asarray(ds.get_label()),
+                                             None if w is None
+                                             else np.asarray(w))
+            init_score = np.asarray(
+                objective.boost_from_score(np.asarray(ds.get_label()),
+                                           None if w is None
+                                           else np.asarray(w)),
+                np.float64).reshape(self.K)
+        elif objective is not None and hasattr(objective,
+                                               "init_label_weights"):
+            objective.init_label_weights(np.asarray(ds.get_label()),
+                                         None if w is None else np.asarray(w))
+        self.init_score = init_score
+
+        score0 = jnp.tile(jnp.asarray(init_score, jnp.float32)[:, None],
+                          (1, self.n))
+        user_init = ds.get_init_score()
+        if user_init is not None:
+            score0 = score0 + jnp.asarray(user_init, jnp.float32).reshape(
+                self.K, self.n)
+        self.score = score0
+
+        hist_method = cfg.hist_method
+        if hist_method == "auto":
+            # tpu may surface as platform "tpu" or a tunneled plugin name
+            hist_method = ("scatter" if jax.default_backend() == "cpu"
+                           else "onehot")
+        self.grow_cfg = GrowConfig(
+            num_leaves=cfg.num_leaves,
+            num_bins=ds.num_total_bins(),
+            max_depth=cfg.max_depth,
+            hist_method=hist_method,
+            split=SplitParams(
+                lambda_l1=cfg.lambda_l1,
+                lambda_l2=cfg.lambda_l2,
+                max_delta_step=cfg.max_delta_step,
+                min_data_in_leaf=float(cfg.min_data_in_leaf),
+                min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
+                min_gain_to_split=cfg.min_gain_to_split,
+            ),
+        )
+        # -- distributed setup: mesh instead of Network::Init ------------
+        # (SURVEY.md §2.6: the socket/MPI linker layer disappears; rows
+        # are sharded over a jax Mesh and XLA emits the collectives)
+        self.mesh = None
+        self._pad = 0
+        self._grow_fn = None
+        ndev = len(jax.devices())
+        want_dp = (cfg.tree_learner in ("data", "feature", "voting")
+                   or cfg.num_devices > 1)
+        if want_dp and ndev > 1:
+            from ..parallel.data_parallel import make_dp_grow_fn
+            from ..parallel.mesh import make_mesh, pad_rows
+            self.mesh = make_mesh(cfg.num_devices)
+            D = int(self.mesh.devices.size)
+            self._pad = pad_rows(self.n, D)
+            if self._pad:
+                self.bins_T = jnp.pad(self.bins_T,
+                                      ((0, 0), (0, self._pad)))
+            self._grow_fn = make_dp_grow_fn(
+                self.grow_cfg, self.mesh, self.monotone is not None)
+
+        seed = cfg.seed if cfg.seed is not None else 0
+        self._base_key = jax.random.PRNGKey(seed)
+        self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
+        # DART state (dart.hpp)
+        self._dart_rng = np.random.RandomState(cfg.drop_seed)
+        self._tree_weights: List[float] = []  # per-model weight (DART/RF)
+
+    # ------------------------------------------------------------------
+    def add_valid(self, dataset, name: str) -> None:
+        score = self._score_dataset_binned(dataset)
+        self.valid_sets.append(_ValidData(dataset, score, name))
+
+    def _score_dataset_binned(self, dataset) -> jnp.ndarray:
+        nv = dataset.num_data()
+        is_rf = self.cfg.boosting == "rf"
+        if self._fold_bias or is_rf:
+            # bias lives inside tree leaf values (first iteration's trees
+            # for gbdt/dart; every tree for rf)
+            score = jnp.zeros((self.K, nv), jnp.float32)
+        else:
+            score = jnp.tile(jnp.asarray(self.init_score,
+                                         jnp.float32)[:, None], (1, nv))
+        ui = dataset.get_init_score()
+        if ui is not None:
+            score = score + jnp.asarray(ui, jnp.float32).reshape(self.K, nv)
+        bins_T = dataset.device_bins()
+        for i, tree in enumerate(self.models):
+            k = i % self.K
+            score = score.at[k].add(self._predict_tree_binned_host(
+                tree, bins_T))
+        if is_rf and self.iter_ > 0:
+            # rf scores are the running average of unscaled tree outputs
+            score = score / self.iter_
+        return score
+
+    def _predict_tree_binned_host(self, tree: Tree,
+                                  bins_T: jnp.ndarray) -> jnp.ndarray:
+        if tree.num_leaves <= 1:
+            return jnp.full((bins_T.shape[1],), float(tree.leaf_value[0]),
+                            jnp.float32)
+        # map real feature index back to inner (used-feature) index
+        inner = self.train_set.inner_feature_index(tree.split_feature)
+        tb = tree.threshold_bin
+        if (tb < 0).any():
+            tb = self.train_set.thresholds_to_bins(tree.split_feature,
+                                                   tree.threshold)
+        # pad to the configured num_leaves so the jitted traversal
+        # compiles once per dataset, not once per tree
+        L = max(self.cfg.num_leaves, tree.num_leaves)
+        nn = L - 1
+
+        def pad(a, size, fill, dt):
+            out = np.full((size,), fill, dt)
+            out[: len(a)] = a
+            return out
+
+        return _tree_values_binned(
+            jnp.asarray(pad(inner, nn, 0, np.int32)),
+            jnp.asarray(pad(tb, nn, 0, np.int32)),
+            jnp.asarray(pad((tree.decision_type & 2) != 0, nn, False, bool)),
+            jnp.asarray(pad(tree.left_child, nn, -1, np.int32)),
+            jnp.asarray(pad(tree.right_child, nn, -1, np.int32)),
+            jnp.asarray(pad(tree.leaf_value, L, 0.0, np.float32)),
+            self.feat_nan_bin, bins_T)
+
+    # ------------------------------------------------------------------
+    # sampling strategies (bagging.hpp / goss.hpp analogs)
+    # ------------------------------------------------------------------
+    def _row_weights(self, it: int, grad: jnp.ndarray,
+                     hess: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        n = self.n
+        if cfg.data_sample_strategy == "goss":
+            # GOSS (goss.hpp:30): keep top |g*h|, sample + amplify the rest
+            if it < max(1, int(1.0 / cfg.learning_rate)):
+                return jnp.ones((n,), jnp.float32)
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(cfg.bagging_seed), it)
+            metric = jnp.abs(grad) * hess if grad.ndim == 1 else \
+                jnp.sum(jnp.abs(grad) * hess, axis=0)
+            thresh = jnp.quantile(metric, 1.0 - cfg.top_rate)
+            top = metric >= thresh
+            rest_prob = cfg.other_rate / max(1e-12, 1.0 - cfg.top_rate)
+            amplify = (1.0 - cfg.top_rate) / max(1e-12, cfg.other_rate)
+            u = jax.random.uniform(key, (n,))
+            other = (~top) & (u < rest_prob)
+            return top.astype(jnp.float32) + \
+                other.astype(jnp.float32) * amplify
+        if cfg.bagging_freq > 0 and (cfg.bagging_fraction < 1.0
+                                     or cfg.pos_bagging_fraction < 1.0
+                                     or cfg.neg_bagging_fraction < 1.0):
+            if it % cfg.bagging_freq != 0 and self._cached_bag is not None:
+                return self._cached_bag
+            key = jax.random.fold_in(
+                jax.random.PRNGKey(cfg.bagging_seed), it)
+            u = jax.random.uniform(key, (n,))
+            if (cfg.pos_bagging_fraction < 1.0
+                    or cfg.neg_bagging_fraction < 1.0):
+                is_pos = self.label > 0
+                frac = jnp.where(is_pos, cfg.pos_bagging_fraction,
+                                 cfg.neg_bagging_fraction)
+                bag = (u < frac).astype(jnp.float32)
+            else:
+                bag = (u < cfg.bagging_fraction).astype(jnp.float32)
+            self._cached_bag = bag
+            return bag
+        return jnp.ones((n,), jnp.float32)
+
+    _cached_bag: Optional[jnp.ndarray] = None
+
+    def _feature_mask(self) -> jnp.ndarray:
+        """Per-tree column sampling (ColSampler::ResetByTree analog)."""
+        cfg = self.cfg
+        usable = self.train_set.usable_feature_mask()
+        if cfg.feature_fraction >= 1.0:
+            return jnp.asarray(usable)
+        idx = np.where(usable)[0]
+        k = max(1, int(round(len(idx) * cfg.feature_fraction)))
+        chosen = self._feature_rng.choice(idx, size=k, replace=False)
+        mask = np.zeros((self.F,), bool)
+        mask[chosen] = True
+        return jnp.asarray(mask)
+
+    # ------------------------------------------------------------------
+    def _gradients(self, score: jnp.ndarray
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        g, h = self.objective.grad_hess(
+            score if self.K > 1 else score[0], self.label, self.weight)
+        if self.K == 1:
+            g, h = g[None, :], h[None, :]
+        return g, h
+
+    def train_one_iter(self,
+                       custom_grad: Optional[np.ndarray] = None,
+                       custom_hess: Optional[np.ndarray] = None) -> bool:
+        """One boosting iteration (TrainOneIter, gbdt.cpp:344).
+        Returns True if no tree could be grown (training finished)."""
+        cfg = self.cfg
+        it = self.iter_
+
+        # DART: pick and temporarily drop trees (dart.hpp DroppingTrees)
+        drop_idx: List[int] = []
+        if cfg.boosting == "dart" and self.models:
+            drop_idx = self._dart_select_drop()
+            if drop_idx:
+                self._dart_apply_drop(drop_idx)
+
+        if custom_grad is not None:
+            grad = jnp.asarray(custom_grad, jnp.float32).reshape(self.K,
+                                                                 self.n)
+            hess = jnp.asarray(custom_hess, jnp.float32).reshape(self.K,
+                                                                 self.n)
+        elif cfg.boosting == "rf":
+            # RF trees are independent: gradients always from the init
+            # score, never the running average (rf.hpp Boosting)
+            init = jnp.tile(jnp.asarray(self.init_score,
+                                        jnp.float32)[:, None], (1, self.n))
+            grad, hess = self._gradients(init)
+        else:
+            grad, hess = self._gradients(self.score)
+
+        row_w = self._row_weights(it, grad[0] if self.K == 1 else grad,
+                                  hess[0] if self.K == 1 else hess)
+        fmask = self._feature_mask()
+
+        shrinkage = self._shrinkage if cfg.boosting != "rf" else 1.0
+        grew_any = False
+        for k in range(self.K):
+            if self.mesh is not None:
+                gk = grad[k]
+                hk = hess[k]
+                rwk = row_w
+                if self._pad:
+                    gk = jnp.pad(gk, (0, self._pad))
+                    hk = jnp.pad(hk, (0, self._pad))
+                    rwk = jnp.pad(rwk, (0, self._pad))
+                args = (self.bins_T, gk, hk, rwk, fmask,
+                        self.feat_num_bins, self.feat_nan_bin)
+                if self.monotone is not None:
+                    args = args + (self.monotone,)
+                dev_tree, row_leaf = self._grow_fn(*args)
+                row_leaf = row_leaf[: self.n]
+            else:
+                dev_tree, row_leaf = grow_tree(
+                    self.grow_cfg, self.bins_T, grad[k], hess[k], row_w,
+                    fmask, self.feat_num_bins, self.feat_nan_bin,
+                    self.monotone)
+            num_leaves = int(np.asarray(dev_tree.num_leaves))
+            if num_leaves <= 1:
+                # constant tree; carries the boost_from_average bias when
+                # it is the first iteration (gbdt.cpp models_.size() check /
+                # rf.hpp AsConstantTree path)
+                tree = tree_from_arrays(dev_tree, self.train_set.mappers,
+                                        self.train_set.used_feature_indices())
+                bias = 0.0
+                if it == 0 and (self._fold_bias or cfg.boosting == "rf"):
+                    bias = float(self.init_score[k])
+                tree.leaf_value[:] = bias
+                self.models.append(tree)
+                self._tree_weights.append(1.0)
+                if cfg.boosting == "rf":
+                    self.score = self.score.at[k].set(
+                        (self.score[k] * it + bias) / (it + 1))
+                    for v in self.valid_sets:
+                        v.score = v.score.at[k].set(
+                            (v.score[k] * it + bias) / (it + 1))
+                elif bias != 0.0:
+                    for v in self.valid_sets:
+                        v.score = v.score.at[k].add(bias)
+                continue
+            grew_any = True
+
+            # objective-specific per-leaf refinement (RenewTreeOutput).
+            # rf refines against the init score, not the running average
+            # (rf.hpp residual_getter uses init_scores_).
+            leaf_values = dev_tree.leaf_value
+            if (self.objective is not None and self.objective.need_renew
+                    and custom_grad is None):
+                if cfg.boosting == "rf":
+                    base = jnp.full((self.n,), float(self.init_score[k]),
+                                    jnp.float32)
+                else:
+                    base = self.score[k]
+                resid = self.objective.renew_residual(base, self.label)
+                rw = self.objective.renew_weight(self.label, self.weight)
+                rw = row_w if rw is None else row_w * rw
+                leaf_values = renew_leaf_values(
+                    row_leaf, resid, rw, cfg.num_leaves,
+                    self.objective.renew_alpha, leaf_values)
+                dev_tree = dev_tree._replace(leaf_value=leaf_values)
+
+            tree = tree_from_arrays(dev_tree, self.train_set.mappers,
+                                    self.train_set.used_feature_indices())
+            tree.apply_shrinkage(shrinkage)
+            fold_now = (cfg.boosting == "rf") or (it == 0 and self._fold_bias)
+            if fold_now and self.init_score[k] != 0.0:
+                # Tree::AddBias: the constant rides inside leaf values so
+                # the model file is self-contained (every tree for rf)
+                tree.leaf_value = tree.leaf_value + self.init_score[k]
+                tree.internal_value = tree.internal_value \
+                    + self.init_score[k]
+            self.models.append(tree)
+            self._tree_weights.append(1.0)
+
+            if cfg.boosting == "rf":
+                # running average of unscaled tree outputs (rf.hpp
+                # MultiplyScore m -> UpdateScore -> MultiplyScore 1/(m+1))
+                contrib = leaf_values[row_leaf] + float(self.init_score[k])
+                self.score = self.score.at[k].set(
+                    (self.score[k] * it + contrib) / (it + 1))
+                for v in self.valid_sets:
+                    dv = self._predict_tree_binned_host(
+                        tree, v.dataset.device_bins())
+                    v.score = v.score.at[k].set(
+                        (v.score[k] * it + dv) / (it + 1))
+            else:
+                # train-score update via the leaf partition — no
+                # re-traversal (ScoreUpdater::AddScore, score_updater.hpp)
+                self.score = self.score.at[k].add(
+                    leaf_values[row_leaf] * shrinkage)
+                if it == 0 and self._fold_bias \
+                        and self.init_score[k] != 0.0:
+                    # internal score already starts at init; nothing to add
+                    pass
+                for v in self.valid_sets:
+                    v.score = v.score.at[k].add(
+                        self._predict_tree_binned_host(
+                            tree, v.dataset.device_bins()))
+
+        if cfg.boosting == "dart" and drop_idx and grew_any:
+            self._dart_normalize(drop_idx)
+
+        self.iter_ += 1
+        return not grew_any
+
+    # ------------------------------------------------------------------
+    # DART (dart.hpp)
+    # ------------------------------------------------------------------
+    def _dart_select_drop(self) -> List[int]:
+        cfg = self.cfg
+        n_models = len(self.models)
+        n_iters = n_models // self.K
+        if self._dart_rng.rand() < cfg.skip_drop or n_iters == 0:
+            return []
+        if cfg.uniform_drop:
+            mask = self._dart_rng.rand(n_iters) < cfg.drop_rate
+            drop_iters = np.where(mask)[0]
+        else:
+            k = min(max(1, int(round(n_iters * cfg.drop_rate))), cfg.max_drop)
+            drop_iters = self._dart_rng.choice(n_iters, size=min(k, n_iters),
+                                               replace=False)
+        if len(drop_iters) > cfg.max_drop > 0:
+            drop_iters = drop_iters[:cfg.max_drop]
+        out = []
+        for i in drop_iters:
+            out.extend(range(i * self.K, (i + 1) * self.K))
+        return sorted(out)
+
+    def _dart_apply_drop(self, drop_idx: List[int]) -> None:
+        """Remove dropped trees' contribution from all score vectors."""
+        for i in drop_idx:
+            k = i % self.K
+            tree = self.models[i]
+            self.score = self.score.at[k].add(
+                -self._predict_tree_binned_host(
+                    tree, self.train_set.device_bins()))
+            for v in self.valid_sets:
+                v.score = v.score.at[k].add(-self._predict_tree_binned_host(
+                    tree, v.dataset.device_bins()))
+
+    def _dart_normalize(self, drop_idx: List[int]) -> None:
+        """Shrink re-added dropped trees and the new tree (dart.hpp
+        Normalize)."""
+        cfg = self.cfg
+        kd = len(drop_idx) // self.K
+        if cfg.xgboost_dart_mode:
+            new_w = self._shrinkage / (kd + self._shrinkage)
+            old_factor = kd / (kd + self._shrinkage)
+        else:
+            new_w = 1.0 / (kd + 1.0)
+            old_factor = kd / (kd + 1.0)
+        # scale the trees added this iteration
+        for i in range(len(self.models) - self.K, len(self.models)):
+            if self.models[i].num_leaves > 1:
+                k = i % self.K
+                delta = self._predict_tree_binned_host(self.models[i],
+                                                       self.train_set.device_bins())
+                self.score = self.score.at[k].add(delta * (new_w - 1.0))
+                for v in self.valid_sets:
+                    dv = self._predict_tree_binned_host(
+                        self.models[i], v.dataset.device_bins())
+                    v.score = v.score.at[k].add(dv * (new_w - 1.0))
+                self.models[i].apply_shrinkage(new_w)
+        # scale the dropped trees and re-add
+        for i in drop_idx:
+            k = i % self.K
+            self.models[i].apply_shrinkage(old_factor)
+            delta = self._predict_tree_binned_host(self.models[i],
+                                                   self.train_set.device_bins())
+            self.score = self.score.at[k].add(delta)
+            for v in self.valid_sets:
+                dv = self._predict_tree_binned_host(self.models[i],
+                                                    v.dataset.device_bins())
+                v.score = v.score.at[k].add(dv)
+
+    # ------------------------------------------------------------------
+    def rollback_one_iter(self) -> None:
+        """RollbackOneIter (gbdt.cpp:454)."""
+        if not self.models:
+            return
+        is_rf = self.cfg.boosting == "rf"
+        m = self.iter_ - 1  # iterations remaining after rollback
+        for k in reversed(range(self.K)):
+            tree = self.models.pop()
+            self._tree_weights.pop()
+            if is_rf:
+                dv = self._predict_tree_binned_host(
+                    tree, self.train_set.device_bins())
+                if m > 0:
+                    self.score = self.score.at[k].set(
+                        (self.score[k] * (m + 1) - dv) / m)
+                else:
+                    self.score = self.score.at[k].set(jnp.full_like(
+                        self.score[k], float(self.init_score[k])))
+                for v in self.valid_sets:
+                    vv = self._predict_tree_binned_host(
+                        tree, v.dataset.device_bins())
+                    if m > 0:
+                        v.score = v.score.at[k].set(
+                            (v.score[k] * (m + 1) - vv) / m)
+                    else:
+                        v.score = v.score.at[k].set(
+                            jnp.zeros_like(v.score[k]))
+                continue
+            if tree.num_leaves > 1 or tree.leaf_value[0] != 0.0:
+                delta = self._predict_tree_binned_host(
+                    tree, self.train_set.device_bins())
+                self.score = self.score.at[k].add(-delta)
+                if m == 0 and self._fold_bias:
+                    # the popped iter-0 tree carried the folded bias, but
+                    # the internal train score starts at init: restore it
+                    self.score = self.score.at[k].add(
+                        float(self.init_score[k]))
+                for v in self.valid_sets:
+                    dv = self._predict_tree_binned_host(
+                        tree, v.dataset.device_bins())
+                    v.score = v.score.at[k].add(-dv)
+        self.iter_ -= 1
+
+    def eval_metrics(self, metrics, data_idx: int) -> Dict[str, float]:
+        """data_idx 0 = train, 1.. = valid sets."""
+        if data_idx == 0:
+            score, ds = self.score, self.train_set
+        else:
+            v = self.valid_sets[data_idx - 1]
+            score, ds = v.score, v.dataset
+        label = jnp.asarray(ds.get_label(), jnp.float32)
+        w = ds.get_weight()
+        weight = None if w is None else jnp.asarray(w, jnp.float32)
+        convert = (self.objective.convert_output
+                   if self.objective is not None else (lambda s: s))
+        out = {}
+        for m in metrics:
+            extra = {}
+            if hasattr(m, "eval_with_query"):
+                val = m.eval_with_query(score, label, weight, ds, convert)
+            else:
+                val = m.eval(score, label, weight, convert)
+            out[m.name] = float(val)
+        return out
+
+    def current_score(self, data_idx: int) -> np.ndarray:
+        if data_idx == 0:
+            return np.asarray(self.score)
+        return np.asarray(self.valid_sets[data_idx - 1].score)

@@ -1,0 +1,258 @@
+"""Host-side tree model + LightGBM-compatible text serialization.
+
+Re-design of the reference Tree (/root/reference/include/LightGBM/tree.h,
+src/io/tree.cpp) and the model text format
+(src/boosting/gbdt_model_text.cpp:410 SaveModelToString / :421
+LoadModelFromString). Trees are plain numpy arrays on the host; for batch
+prediction a whole forest is stacked into a few device tensors
+(ops/predict.py StackedTrees).
+
+decision_type byte layout (tree.h kCategoricalMask/kDefaultLeftMask):
+  bit0 = categorical split, bit1 = default_left, bits2-3 = missing_type
+  (0 = none, 1 = zero, 2 = nan).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..ops.binning import BinMapper, BinType, MissingType
+
+__all__ = ["Tree", "tree_from_arrays"]
+
+_MISSING_CODE = {MissingType.NONE: 0, MissingType.ZERO: 1, MissingType.NAN: 2}
+_MISSING_NAME = {0: MissingType.NONE, 1: MissingType.ZERO, 2: MissingType.NAN}
+
+CAT_MASK = 1
+DEFAULT_LEFT_MASK = 2
+
+
+@dataclasses.dataclass
+class Tree:
+    num_leaves: int
+    split_feature: np.ndarray       # [L-1] i32
+    split_gain: np.ndarray          # [L-1] f32
+    threshold: np.ndarray           # [L-1] f64 (real-valued)
+    threshold_bin: np.ndarray       # [L-1] i32 (bin-space; -1 if unknown)
+    decision_type: np.ndarray       # [L-1] u8
+    left_child: np.ndarray          # [L-1] i32
+    right_child: np.ndarray         # [L-1] i32
+    leaf_value: np.ndarray          # [L] f64
+    leaf_weight: np.ndarray         # [L] f64
+    leaf_count: np.ndarray          # [L] i64
+    internal_value: np.ndarray      # [L-1] f64
+    internal_weight: np.ndarray     # [L-1] f64
+    internal_count: np.ndarray      # [L-1] i64
+    shrinkage: float = 1.0
+    # categorical splits: threshold_bin indexes into cat_threshold via
+    # cat_boundaries (bitset spans), like tree.h cat_boundaries_
+    num_cat: int = 0
+    cat_boundaries: Optional[np.ndarray] = None
+    cat_threshold: Optional[np.ndarray] = None
+    is_linear: bool = False
+
+    @property
+    def num_nodes(self) -> int:
+        return max(self.num_leaves - 1, 0)
+
+    def is_categorical_node(self, i: int) -> bool:
+        return bool(self.decision_type[i] & CAT_MASK)
+
+    def default_left(self, i: int) -> bool:
+        return bool(self.decision_type[i] & DEFAULT_LEFT_MASK)
+
+    def missing_type(self, i: int) -> int:
+        return (int(self.decision_type[i]) >> 2) & 3
+
+    def apply_shrinkage(self, rate: float) -> None:
+        """Tree::Shrinkage (tree.h:188)."""
+        self.leaf_value = self.leaf_value * rate
+        self.internal_value = self.internal_value * rate
+        self.shrinkage *= rate
+
+    def num_leaves_actual(self) -> int:
+        return self.num_leaves
+
+    # -- single-row host predict (reference: tree.h:134) ------------------
+    def predict_row(self, x: np.ndarray) -> float:
+        leaf = self.predict_leaf_row(x)
+        return float(self.leaf_value[leaf])
+
+    def predict_leaf_row(self, x: np.ndarray) -> int:
+        if self.num_leaves == 1:
+            return 0
+        node = 0
+        while node >= 0:
+            f = self.split_feature[node]
+            v = x[f]
+            if self.is_categorical_node(node):
+                go_left = self._cat_decision(node, v)
+            else:
+                go_left = self._num_decision(node, v)
+            node = self.left_child[node] if go_left else self.right_child[node]
+        return ~node
+
+    def _num_decision(self, node: int, v: float) -> bool:
+        mt = self.missing_type(node)
+        if np.isnan(v) and mt != 2:
+            v = 0.0
+        if mt == 2 and np.isnan(v):
+            return self.default_left(node)
+        if mt == 1 and (abs(v) <= 1e-35):
+            return self.default_left(node)
+        return v <= self.threshold[node]
+
+    def _cat_decision(self, node: int, v: float) -> bool:
+        if np.isnan(v) or v < 0:
+            return False
+        iv = int(v)
+        cat_idx = int(self.threshold[node])
+        lo = self.cat_boundaries[cat_idx]
+        hi = self.cat_boundaries[cat_idx + 1]
+        word = iv // 32
+        if word >= hi - lo:
+            return False
+        return bool((int(self.cat_threshold[lo + word]) >> (iv % 32)) & 1)
+
+    # -- text format ------------------------------------------------------
+    def to_string(self, index: int) -> str:
+        def fmt(arr, f):
+            return " ".join(f % x for x in arr)
+
+        L = self.num_leaves
+        lines = [f"Tree={index}", f"num_leaves={L}",
+                 f"num_cat={self.num_cat}"]
+        if L > 1:
+            lines += [
+                "split_feature=" + fmt(self.split_feature, "%d"),
+                "split_gain=" + fmt(self.split_gain, "%g"),
+                "threshold=" + fmt(self.threshold, "%.17g"),
+                "decision_type=" + fmt(self.decision_type, "%d"),
+                "left_child=" + fmt(self.left_child, "%d"),
+                "right_child=" + fmt(self.right_child, "%d"),
+                "leaf_value=" + fmt(self.leaf_value, "%.17g"),
+                "leaf_weight=" + fmt(self.leaf_weight, "%g"),
+                "leaf_count=" + fmt(self.leaf_count, "%d"),
+                "internal_value=" + fmt(self.internal_value, "%g"),
+                "internal_weight=" + fmt(self.internal_weight, "%g"),
+                "internal_count=" + fmt(self.internal_count, "%d"),
+            ]
+            if self.num_cat > 0:
+                lines += [
+                    "cat_boundaries=" + fmt(self.cat_boundaries, "%d"),
+                    "cat_threshold=" + fmt(self.cat_threshold, "%d"),
+                ]
+        else:
+            lines += ["leaf_value=" + fmt(self.leaf_value[:1], "%.17g")]
+        lines += [f"is_linear={int(self.is_linear)}",
+                  f"shrinkage={self.shrinkage:g}"]
+        return "\n".join(lines) + "\n\n"
+
+    @classmethod
+    def from_lines(cls, kv: Dict[str, str]) -> "Tree":
+        L = int(kv["num_leaves"])
+        num_cat = int(kv.get("num_cat", "0"))
+
+        def arr(key, dtype, size, default=0):
+            if key not in kv or size == 0:
+                return np.full(size, default, dtype)
+            vals = kv[key].split()
+            return np.asarray(vals, dtype=dtype)
+
+        n_nodes = max(L - 1, 0)
+        t = cls(
+            num_leaves=L,
+            split_feature=arr("split_feature", np.int32, n_nodes),
+            split_gain=arr("split_gain", np.float64, n_nodes),
+            threshold=arr("threshold", np.float64, n_nodes),
+            threshold_bin=np.full(n_nodes, -1, np.int32),
+            decision_type=arr("decision_type", np.uint8, n_nodes),
+            left_child=arr("left_child", np.int32, n_nodes),
+            right_child=arr("right_child", np.int32, n_nodes),
+            leaf_value=arr("leaf_value", np.float64, L),
+            leaf_weight=arr("leaf_weight", np.float64, L),
+            leaf_count=arr("leaf_count", np.int64, L),
+            internal_value=arr("internal_value", np.float64, n_nodes),
+            internal_weight=arr("internal_weight", np.float64, n_nodes),
+            internal_count=arr("internal_count", np.int64, n_nodes),
+            num_cat=num_cat,
+            shrinkage=float(kv.get("shrinkage", "1")),
+            is_linear=bool(int(kv.get("is_linear", "0"))),
+        )
+        if num_cat > 0:
+            t.cat_boundaries = np.asarray(kv["cat_boundaries"].split(),
+                                          np.int64)
+            t.cat_threshold = np.asarray(kv["cat_threshold"].split(),
+                                         np.uint32)
+        return t
+
+
+def tree_from_arrays(dev_tree, mappers: Sequence[BinMapper],
+                     used_features: Optional[np.ndarray] = None) -> Tree:
+    """Convert device TreeArrays (ops/grow.py) to a host Tree, realizing
+    bin-space thresholds as real values via the BinMappers."""
+    L = int(np.asarray(dev_tree.num_leaves))
+    nn = max(L - 1, 0)
+    inner_sf = np.asarray(dev_tree.split_feature)[:nn].astype(np.int32)
+    if used_features is not None:
+        sf = used_features[inner_sf].astype(np.int32)
+    else:
+        sf = inner_sf
+    tb = np.asarray(dev_tree.threshold_bin)[:nn].astype(np.int32)
+    dl = np.asarray(dev_tree.default_left)[:nn]
+    thr = np.zeros(nn, np.float64)
+    dtypes = np.zeros(nn, np.uint8)
+    cat_boundaries = [0]
+    cat_threshold: List[int] = []
+    num_cat = 0
+    for i in range(nn):
+        # mappers are one-per-used-feature: index by the inner id
+        m = mappers[inner_sf[i]]
+        code = _MISSING_CODE[m.missing_type] << 2
+        if m.bin_type == BinType.CATEGORICAL:
+            # The grower split "bin <= t -> left" over frequency-ordered
+            # category bins; realize it as a bitset over the raw category
+            # values of bins [0, t] (tree.h SplitCategorical layout:
+            # threshold = index into cat_boundaries_).
+            cats = np.asarray(m.bin_to_cat[: int(tb[i]) + 1], np.int64)
+            nwords = (int(cats.max()) // 32 + 1) if len(cats) else 1
+            words = np.zeros(nwords, np.uint32)
+            for c in cats:
+                words[c // 32] |= np.uint32(1) << np.uint32(c % 32)
+            thr[i] = float(num_cat)
+            code |= CAT_MASK
+            cat_threshold.extend(int(x) for x in words)
+            cat_boundaries.append(len(cat_threshold))
+            num_cat += 1
+        else:
+            thr[i] = m.bin_upper_bound(int(tb[i]))
+            if dl[i]:
+                code |= DEFAULT_LEFT_MASK
+        dtypes[i] = code
+    return Tree(
+        num_cat=num_cat,
+        cat_boundaries=np.asarray(cat_boundaries, np.int64)
+        if num_cat else None,
+        cat_threshold=np.asarray(cat_threshold, np.uint32)
+        if num_cat else None,
+        num_leaves=L,
+        split_feature=sf,
+        split_gain=np.asarray(dev_tree.split_gain)[:nn].astype(np.float64),
+        threshold=thr,
+        threshold_bin=tb,
+        decision_type=dtypes,
+        left_child=np.asarray(dev_tree.left_child)[:nn].astype(np.int32),
+        right_child=np.asarray(dev_tree.right_child)[:nn].astype(np.int32),
+        leaf_value=np.asarray(dev_tree.leaf_value)[:L].astype(np.float64),
+        leaf_weight=np.asarray(dev_tree.leaf_weight)[:L].astype(np.float64),
+        leaf_count=np.asarray(dev_tree.leaf_count)[:L].astype(np.int64),
+        internal_value=np.asarray(
+            dev_tree.internal_value)[:nn].astype(np.float64),
+        internal_weight=np.asarray(
+            dev_tree.internal_weight)[:nn].astype(np.float64),
+        internal_count=np.asarray(
+            dev_tree.internal_count)[:nn].astype(np.int64),
+    )

@@ -1,0 +1,477 @@
+"""Objective functions (gradient/hessian providers).
+
+Re-design of /root/reference/src/objective/* (regression_objective.hpp,
+binary_objective.hpp, multiclass_objective.hpp, xentropy_objective.hpp,
+rank_objective.hpp; factory objective_function.cpp:20-100) as pure-jnp
+vectorized gradient functions traced inside the jitted boosting step.
+
+Interface (ObjectiveFunction analog, objective_function.h):
+  - ``grad_hess(score, label, weight) -> (grad, hess)`` with score shaped
+    ``[K, n]`` (K = models per iteration; 1 except multiclass),
+  - ``boost_from_score(label, weight) -> [K]`` init scores,
+  - ``convert_output(score)`` raw score -> prediction space,
+  - ``renew_leaf_values(...)`` optional per-leaf output refinement
+    (RenewTreeOutput analog — percentile/median leaf refits for the
+    L1-family, regression_objective.hpp).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import Config
+
+__all__ = ["create_objective", "Objective"]
+
+
+def _wsum(x, w):
+    return jnp.sum(x * w) if w is not None else jnp.sum(x)
+
+
+def _weighted_percentile_np(values: np.ndarray, weights: Optional[np.ndarray],
+                            alpha: float) -> float:
+    """Host-side weighted percentile (PercentileFun analog,
+    regression_objective.hpp)."""
+    if len(values) == 0:
+        return 0.0
+    order = np.argsort(values, kind="stable")
+    v = values[order]
+    if weights is None:
+        idx = alpha * (len(v) - 1)
+        lo = int(np.floor(idx))
+        hi = min(lo + 1, len(v) - 1)
+        frac = idx - lo
+        return float(v[lo] * (1 - frac) + v[hi] * frac)
+    w = weights[order]
+    cw = np.cumsum(w)
+    cutoff = alpha * cw[-1]
+    i = int(np.searchsorted(cw, cutoff))
+    return float(v[min(i, len(v) - 1)])
+
+
+class Objective:
+    """Base objective. Subclasses override the jnp methods."""
+
+    name = "custom"
+    num_model_per_iteration = 1
+    is_ranking = False
+    need_renew = False          # L1-family per-leaf percentile refit
+    renew_alpha = 0.5           # percentile used by renew (0.5 = median)
+
+    def __init__(self, cfg: Config):
+        self.cfg = cfg
+
+    # -- jittable core ---------------------------------------------------
+    def grad_hess(self, score: jnp.ndarray, label: jnp.ndarray,
+                  weight: Optional[jnp.ndarray]
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        raise NotImplementedError
+
+    def convert_output(self, score: jnp.ndarray) -> jnp.ndarray:
+        return score
+
+    # -- host-side init --------------------------------------------------
+    def boost_from_score(self, label: np.ndarray,
+                         weight: Optional[np.ndarray]) -> np.ndarray:
+        return np.zeros((self.num_model_per_iteration,), np.float64)
+
+    def transform_label(self, label: np.ndarray) -> np.ndarray:
+        return label
+
+    # residual used by the percentile renew (pred space)
+    def renew_residual(self, score, label):
+        return label - score
+
+    def renew_weight(self, label: jnp.ndarray,
+                     weight: Optional[jnp.ndarray]) -> Optional[jnp.ndarray]:
+        return weight
+
+
+def _apply_weight(g, h, weight):
+    if weight is None:
+        return g, h
+    return g * weight, h * weight
+
+
+# ---------------------------------------------------------------------------
+# Regression family (regression_objective.hpp)
+# ---------------------------------------------------------------------------
+class RegressionL2(Objective):
+    name = "regression"
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self.sqrt = cfg.reg_sqrt
+
+    def transform_label(self, label):
+        if self.sqrt:
+            return np.sign(label) * np.sqrt(np.abs(label))
+        return label
+
+    def grad_hess(self, score, label, weight):
+        g = 2.0 * (score - label)
+        h = jnp.full_like(score, 2.0)
+        return _apply_weight(g, h, weight)
+
+    def convert_output(self, score):
+        if self.sqrt:
+            return jnp.sign(score) * score * score
+        return score
+
+    def boost_from_score(self, label, weight):
+        if weight is None:
+            avg = float(np.mean(label))
+        else:
+            avg = float(np.sum(label * weight) / np.sum(weight))
+        return np.array([avg])
+
+
+class RegressionL1(Objective):
+    name = "regression_l1"
+    need_renew = True
+    renew_alpha = 0.5
+
+    def grad_hess(self, score, label, weight):
+        g = jnp.sign(score - label)
+        h = jnp.ones_like(score)
+        return _apply_weight(g, h, weight)
+
+    def boost_from_score(self, label, weight):
+        return np.array([_weighted_percentile_np(label, weight, 0.5)])
+
+
+class Huber(Objective):
+    name = "huber"
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self.alpha = cfg.alpha
+
+    def grad_hess(self, score, label, weight):
+        d = score - label
+        g = jnp.clip(d, -self.alpha, self.alpha)
+        h = jnp.ones_like(score)
+        return _apply_weight(g, h, weight)
+
+    def boost_from_score(self, label, weight):
+        return np.array([_weighted_percentile_np(label, weight, 0.5)])
+
+
+class Fair(Objective):
+    name = "fair"
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self.c = cfg.fair_c
+
+    def grad_hess(self, score, label, weight):
+        x = score - label
+        denom = jnp.abs(x) + self.c
+        g = self.c * x / denom
+        h = self.c * self.c / (denom * denom)
+        return _apply_weight(g, h, weight)
+
+
+class Poisson(Objective):
+    name = "poisson"
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self.max_delta = cfg.poisson_max_delta_step
+
+    def grad_hess(self, score, label, weight):
+        ex = jnp.exp(score)
+        g = ex - label
+        h = jnp.exp(score + self.max_delta)
+        return _apply_weight(g, h, weight)
+
+    def convert_output(self, score):
+        return jnp.exp(score)
+
+    def boost_from_score(self, label, weight):
+        if weight is None:
+            avg = float(np.mean(label))
+        else:
+            avg = float(np.sum(label * weight) / np.sum(weight))
+        return np.array([np.log(max(avg, 1e-20))])
+
+
+class Quantile(Objective):
+    name = "quantile"
+    need_renew = True
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self.alpha = cfg.alpha
+        self.renew_alpha = cfg.alpha
+
+    def grad_hess(self, score, label, weight):
+        g = jnp.where(score < label, -self.alpha, 1.0 - self.alpha)
+        h = jnp.ones_like(score)
+        return _apply_weight(g, h, weight)
+
+    def boost_from_score(self, label, weight):
+        return np.array([_weighted_percentile_np(label, weight, self.alpha)])
+
+
+class MAPE(Objective):
+    name = "mape"
+    need_renew = True
+    renew_alpha = 0.5
+
+    def grad_hess(self, score, label, weight):
+        scale = 1.0 / jnp.maximum(1.0, jnp.abs(label))
+        g = jnp.sign(score - label) * scale
+        h = scale
+        return _apply_weight(g, h, weight)
+
+    def renew_weight(self, label, weight):
+        scale = 1.0 / jnp.maximum(1.0, jnp.abs(label))
+        return scale if weight is None else weight * scale
+
+    def boost_from_score(self, label, weight):
+        w = 1.0 / np.maximum(1.0, np.abs(label))
+        if weight is not None:
+            w = w * weight
+        return np.array([_weighted_percentile_np(label, w, 0.5)])
+
+
+class Gamma(Objective):
+    name = "gamma"
+
+    def grad_hess(self, score, label, weight):
+        e = jnp.exp(-score)
+        g = 1.0 - label * e
+        h = label * e
+        return _apply_weight(g, h, weight)
+
+    def convert_output(self, score):
+        return jnp.exp(score)
+
+    def boost_from_score(self, label, weight):
+        if weight is None:
+            avg = float(np.mean(label))
+        else:
+            avg = float(np.sum(label * weight) / np.sum(weight))
+        return np.array([np.log(max(avg, 1e-20))])
+
+
+class Tweedie(Objective):
+    name = "tweedie"
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self.rho = cfg.tweedie_variance_power
+
+    def grad_hess(self, score, label, weight):
+        rho = self.rho
+        e1 = jnp.exp((1.0 - rho) * score)
+        e2 = jnp.exp((2.0 - rho) * score)
+        g = -label * e1 + e2
+        h = -label * (1.0 - rho) * e1 + (2.0 - rho) * e2
+        return _apply_weight(g, h, weight)
+
+    def convert_output(self, score):
+        return jnp.exp(score)
+
+    def boost_from_score(self, label, weight):
+        if weight is None:
+            avg = float(np.mean(label))
+        else:
+            avg = float(np.sum(label * weight) / np.sum(weight))
+        return np.array([np.log(max(avg, 1e-20))])
+
+
+# ---------------------------------------------------------------------------
+# Binary (binary_objective.hpp)
+# ---------------------------------------------------------------------------
+class Binary(Objective):
+    name = "binary"
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self.sigmoid = cfg.sigmoid
+        self.is_unbalance = cfg.is_unbalance
+        self.scale_pos_weight = cfg.scale_pos_weight
+        self._label_weights = (1.0, 1.0)  # (neg, pos)
+
+    def init_label_weights(self, label: np.ndarray,
+                           weight: Optional[np.ndarray]) -> None:
+        """is_unbalance reweighting (binary_objective.hpp Init): scale the
+        minority class so pos/neg contribute equally."""
+        cnt_pos = float(np.sum(label > 0))
+        cnt_neg = float(len(label) - cnt_pos)
+        if self.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
+            if cnt_pos > cnt_neg:
+                self._label_weights = (cnt_pos / cnt_neg, 1.0)
+            else:
+                self._label_weights = (1.0, cnt_neg / cnt_pos)
+        else:
+            self._label_weights = (1.0, self.scale_pos_weight)
+
+    def grad_hess(self, score, label, weight):
+        wneg, wpos = self._label_weights
+        sig = self.sigmoid
+        p = jax.nn.sigmoid(sig * score)
+        is_pos = label > 0
+        lw = jnp.where(is_pos, wpos, wneg)
+        y = is_pos.astype(score.dtype)
+        g = sig * (p - y) * lw
+        h = sig * sig * p * (1.0 - p) * lw
+        return _apply_weight(g, h, weight)
+
+    def convert_output(self, score):
+        return jax.nn.sigmoid(self.sigmoid * score)
+
+    def boost_from_score(self, label, weight):
+        y = (label > 0).astype(np.float64)
+        if weight is None:
+            pavg = float(np.mean(y))
+        else:
+            pavg = float(np.sum(y * weight) / np.sum(weight))
+        pavg = min(max(pavg, 1e-15), 1.0 - 1e-15)
+        return np.array([np.log(pavg / (1.0 - pavg)) / self.sigmoid])
+
+
+# ---------------------------------------------------------------------------
+# Multiclass (multiclass_objective.hpp)
+# ---------------------------------------------------------------------------
+class MulticlassSoftmax(Objective):
+    name = "multiclass"
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self.num_class = cfg.num_class
+        self.num_model_per_iteration = cfg.num_class
+
+    def grad_hess(self, score, label, weight):
+        # score: [K, n]
+        p = jax.nn.softmax(score, axis=0)
+        K = self.num_class
+        y = jax.nn.one_hot(label.astype(jnp.int32), K, axis=0,
+                           dtype=score.dtype)
+        factor = K / (K - 1.0)
+        g = p - y
+        h = factor * p * (1.0 - p)
+        if weight is not None:
+            g = g * weight[None, :]
+            h = h * weight[None, :]
+        return g, h
+
+    def convert_output(self, score):
+        return jax.nn.softmax(score, axis=0)
+
+
+class MulticlassOVA(Objective):
+    name = "multiclassova"
+
+    def __init__(self, cfg: Config):
+        super().__init__(cfg)
+        self.num_class = cfg.num_class
+        self.num_model_per_iteration = cfg.num_class
+        self.sigmoid = cfg.sigmoid
+
+    def grad_hess(self, score, label, weight):
+        sig = self.sigmoid
+        K = self.num_class
+        p = jax.nn.sigmoid(sig * score)
+        y = jax.nn.one_hot(label.astype(jnp.int32), K, axis=0,
+                           dtype=score.dtype)
+        g = sig * (p - y)
+        h = sig * sig * p * (1.0 - p)
+        if weight is not None:
+            g = g * weight[None, :]
+            h = h * weight[None, :]
+        return g, h
+
+    def convert_output(self, score):
+        return jax.nn.sigmoid(self.sigmoid * score)
+
+
+# ---------------------------------------------------------------------------
+# Cross-entropy with probabilistic labels (xentropy_objective.hpp)
+# ---------------------------------------------------------------------------
+class CrossEntropy(Objective):
+    name = "cross_entropy"
+
+    def grad_hess(self, score, label, weight):
+        p = jax.nn.sigmoid(score)
+        g = p - label
+        h = p * (1.0 - p)
+        return _apply_weight(g, h, weight)
+
+    def convert_output(self, score):
+        return jax.nn.sigmoid(score)
+
+    def boost_from_score(self, label, weight):
+        if weight is None:
+            pavg = float(np.mean(label))
+        else:
+            pavg = float(np.sum(label * weight) / np.sum(weight))
+        pavg = min(max(pavg, 1e-15), 1.0 - 1e-15)
+        return np.array([np.log(pavg / (1.0 - pavg))])
+
+
+class CrossEntropyLambda(Objective):
+    """Alternative parameterization z = log(1 + exp(score))
+    (CrossEntropyLambda, xentropy_objective.hpp)."""
+
+    name = "cross_entropy_lambda"
+
+    def grad_hess(self, score, label, weight):
+        w = weight if weight is not None else jnp.ones_like(score)
+        es = jnp.exp(score)
+        log1pes = jnp.log1p(es)
+        # z = log1p(exp(s)); dz/ds = sigmoid(s)
+        sig = es / (1.0 + es)
+        # loss = w * [z - label * log(1 - exp(-z))] with the lambda link;
+        # gradients derived analytically:
+        emz = jnp.exp(-log1pes)          # exp(-z) = 1/(1+e^s)
+        one_memz = 1.0 - emz             # 1 - exp(-z) = sigmoid(s)
+        g = sig * (w - label * emz / jnp.maximum(one_memz, 1e-15))
+        # Gauss-Newton style positive hessian
+        h = sig * (1.0 - sig) * (
+            w + label * emz / jnp.maximum(one_memz * one_memz, 1e-15) * sig) \
+            + sig * sig * label * emz / jnp.maximum(one_memz, 1e-15)
+        h = jnp.maximum(h, 1e-15)
+        return g, h
+
+    def convert_output(self, score):
+        return jnp.log1p(jnp.exp(score))
+
+
+# ---------------------------------------------------------------------------
+# factory (objective_function.cpp:20-100)
+# ---------------------------------------------------------------------------
+_REGISTRY = {
+    "regression": RegressionL2,
+    "regression_l1": RegressionL1,
+    "huber": Huber,
+    "fair": Fair,
+    "poisson": Poisson,
+    "quantile": Quantile,
+    "mape": MAPE,
+    "gamma": Gamma,
+    "tweedie": Tweedie,
+    "binary": Binary,
+    "multiclass": MulticlassSoftmax,
+    "multiclassova": MulticlassOVA,
+    "cross_entropy": CrossEntropy,
+    "cross_entropy_lambda": CrossEntropyLambda,
+}
+
+
+def create_objective(cfg: Config) -> Optional[Objective]:
+    if cfg.objective == "custom":
+        return None
+    if cfg.objective in ("lambdarank", "rank_xendcg"):
+        from .ranking import create_ranking_objective
+        return create_ranking_objective(cfg)
+    if cfg.objective not in _REGISTRY:
+        raise ValueError(f"Unknown objective {cfg.objective}")
+    return _REGISTRY[cfg.objective](cfg)

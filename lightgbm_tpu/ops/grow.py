@@ -1,0 +1,299 @@
+"""Leaf-wise tree growth as one jitted XLA program.
+
+Re-design of SerialTreeLearner::Train
+(/root/reference/src/treelearner/serial_tree_learner.cpp:179-245) and the
+device-resident CUDA learner
+(src/treelearner/cuda/cuda_single_gpu_tree_learner.cpp) for TPU:
+
+- The growth loop runs ``num_leaves - 1`` *static* split steps inside a
+  ``lax.fori_loop`` (XLA needs static trip counts); a step whose best gain
+  is <= 0 is a no-op, and since nothing changes afterwards all remaining
+  steps stay no-ops — equivalent to the reference's early ``break``
+  (serial_tree_learner.cpp:225).
+- Rows are never compacted per leaf: a ``row_leaf`` vector (the
+  DataPartition analog, data_partition.hpp) assigns each row to a leaf
+  slot, and leaf histograms are built by masking the per-row payload.
+- Leaf slots follow the reference Tree convention (tree.h: ``Split``):
+  the left child keeps the parent's leaf slot, the right child takes slot
+  ``num_leaves_so_far``; internal node k is created by split k; child
+  pointers store ``~leaf`` for leaves.
+- Histogram subtraction: only the smaller child is scatter-accumulated,
+  the sibling = parent - smaller (serial_tree_learner.cpp:473-520).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .histogram import build_histogram, subtract_histogram
+from .split import SplitParams, SplitResult, find_best_split, leaf_output
+
+__all__ = ["GrowConfig", "TreeArrays", "grow_tree"]
+
+NEG_INF = -jnp.inf
+
+
+class GrowConfig(NamedTuple):
+    """Static (trace-time) growth configuration.
+
+    ``axis_name``: when set, the grower runs inside shard_map/pjit with
+    rows sharded over that mesh axis; histograms and root sums are
+    psum-reduced — the TPU analog of the reference's data-parallel
+    ReduceScatter+Allreduce (data_parallel_tree_learner.cpp:284-294,
+    SURVEY.md §2.6). Split finding then happens identically on every
+    device (deterministic), replacing SyncUpGlobalBestSplit.
+    """
+    num_leaves: int
+    num_bins: int
+    max_depth: int = -1
+    split: SplitParams = SplitParams()
+    hist_method: str = "scatter"
+    axis_name: Optional[str] = None
+
+
+class TreeArrays(NamedTuple):
+    """Flat-tensor tree (the Tree class re-imagined as arrays;
+    include/LightGBM/tree.h:63-252). Sizes: L leaves, L-1 internal nodes."""
+    split_feature: jnp.ndarray   # [L-1] i32
+    threshold_bin: jnp.ndarray   # [L-1] i32
+    default_left: jnp.ndarray    # [L-1] bool
+    left_child: jnp.ndarray      # [L-1] i32 (~leaf for leaves)
+    right_child: jnp.ndarray     # [L-1] i32
+    split_gain: jnp.ndarray      # [L-1] f32
+    internal_value: jnp.ndarray  # [L-1] f32
+    internal_weight: jnp.ndarray  # [L-1] f32
+    internal_count: jnp.ndarray  # [L-1] f32
+    leaf_value: jnp.ndarray      # [L] f32
+    leaf_weight: jnp.ndarray     # [L] f32 (sum of hessians)
+    leaf_count: jnp.ndarray      # [L] f32
+    leaf_parent: jnp.ndarray     # [L] i32
+    leaf_depth: jnp.ndarray      # [L] i32
+    num_leaves: jnp.ndarray      # scalar i32 (actual leaves grown)
+
+
+class _BestSplits(NamedTuple):
+    """Per-leaf-slot best candidate split (the SplitInfo-per-leaf arrays)."""
+    gain: jnp.ndarray
+    feature: jnp.ndarray
+    threshold_bin: jnp.ndarray
+    default_left: jnp.ndarray
+    left_sum_g: jnp.ndarray
+    left_sum_h: jnp.ndarray
+    left_count: jnp.ndarray
+    right_sum_g: jnp.ndarray
+    right_sum_h: jnp.ndarray
+    right_count: jnp.ndarray
+    left_output: jnp.ndarray
+    right_output: jnp.ndarray
+
+    @staticmethod
+    def init(L: int, dtype) -> "_BestSplits":
+        zf = jnp.zeros((L,), dtype=dtype)
+        return _BestSplits(
+            gain=jnp.full((L,), NEG_INF, dtype=dtype),
+            feature=jnp.zeros((L,), jnp.int32),
+            threshold_bin=jnp.zeros((L,), jnp.int32),
+            default_left=jnp.zeros((L,), jnp.bool_),
+            left_sum_g=zf, left_sum_h=zf, left_count=zf,
+            right_sum_g=zf, right_sum_h=zf, right_count=zf,
+            left_output=zf, right_output=zf,
+        )
+
+    def store(self, i, r: SplitResult, allowed) -> "_BestSplits":
+        gain = jnp.where(allowed, r.gain, NEG_INF)
+        return _BestSplits(
+            gain=self.gain.at[i].set(gain),
+            feature=self.feature.at[i].set(r.feature),
+            threshold_bin=self.threshold_bin.at[i].set(r.threshold_bin),
+            default_left=self.default_left.at[i].set(r.default_left),
+            left_sum_g=self.left_sum_g.at[i].set(r.left_sum_g),
+            left_sum_h=self.left_sum_h.at[i].set(r.left_sum_h),
+            left_count=self.left_count.at[i].set(r.left_count),
+            right_sum_g=self.right_sum_g.at[i].set(r.right_sum_g),
+            right_sum_h=self.right_sum_h.at[i].set(r.right_sum_h),
+            right_count=self.right_count.at[i].set(r.right_count),
+            left_output=self.left_output.at[i].set(r.left_output),
+            right_output=self.right_output.at[i].set(r.right_output),
+        )
+
+
+class _GrowState(NamedTuple):
+    tree: TreeArrays
+    best: _BestSplits
+    hists: jnp.ndarray      # [L, F, B, 3]
+    row_leaf: jnp.ndarray   # [n] i32
+    num_splits: jnp.ndarray  # scalar i32
+
+
+def _init_tree(L: int, dtype) -> TreeArrays:
+    return TreeArrays(
+        split_feature=jnp.zeros((L - 1,), jnp.int32),
+        threshold_bin=jnp.zeros((L - 1,), jnp.int32),
+        default_left=jnp.zeros((L - 1,), jnp.bool_),
+        left_child=jnp.zeros((L - 1,), jnp.int32),
+        right_child=jnp.zeros((L - 1,), jnp.int32),
+        split_gain=jnp.zeros((L - 1,), dtype),
+        internal_value=jnp.zeros((L - 1,), dtype),
+        internal_weight=jnp.zeros((L - 1,), dtype),
+        internal_count=jnp.zeros((L - 1,), dtype),
+        leaf_value=jnp.zeros((L,), dtype),
+        leaf_weight=jnp.zeros((L,), dtype),
+        leaf_count=jnp.zeros((L,), dtype),
+        leaf_parent=jnp.full((L,), -1, jnp.int32),
+        leaf_depth=jnp.zeros((L,), jnp.int32),
+        num_leaves=jnp.asarray(1, jnp.int32),
+    )
+
+
+def grow_tree_impl(cfg: GrowConfig,
+                   bins_T: jnp.ndarray,
+                   grad: jnp.ndarray,
+                   hess: jnp.ndarray,
+                   row_weight: jnp.ndarray,
+                   feature_mask: jnp.ndarray,
+                   feat_num_bins: jnp.ndarray,
+                   feat_nan_bin: jnp.ndarray,
+                   monotone_constraints: Optional[jnp.ndarray] = None):
+    """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf).
+
+    Args:
+      bins_T: [F, n] uint8/uint16 bin matrix.
+      grad/hess: [n] float.
+      row_weight: [n] float sampling weight (bagging/GOSS; 1.0 = use row).
+      feature_mask: [F] bool usable-feature mask (feature_fraction etc).
+      feat_num_bins / feat_nan_bin: [F] i32 per-feature bin metadata.
+    """
+    L = cfg.num_leaves
+    B = cfg.num_bins
+    F = bins_T.shape[0]
+    n = bins_T.shape[1]
+    dtype = grad.dtype
+    p = cfg.split
+
+    def psum(x):
+        return lax.psum(x, cfg.axis_name) if cfg.axis_name else x
+
+    def best_for(hist, sg, sh, sc):
+        return find_best_split(hist, sg, sh, sc, feat_num_bins, feat_nan_bin,
+                               feature_mask, p, monotone_constraints)
+
+    # ---- root (GlobalSyncUpBySum analog for the root tuple) ----
+    w = row_weight.astype(dtype)
+    total_g = psum(jnp.sum(grad * w))
+    total_h = psum(jnp.sum(hess * w))
+    total_c = psum(jnp.sum(w))
+    all_rows = jnp.ones((n,), jnp.bool_)
+    root_hist = psum(build_histogram(bins_T, grad, hess, row_weight,
+                                     all_rows, B, cfg.hist_method))
+
+    tree = _init_tree(L, dtype)
+    tree = tree._replace(
+        leaf_value=tree.leaf_value.at[0].set(leaf_output(total_g, total_h, p)),
+        leaf_weight=tree.leaf_weight.at[0].set(total_h),
+        leaf_count=tree.leaf_count.at[0].set(total_c),
+    )
+    best = _BestSplits.init(L, dtype)
+    best = best.store(0, best_for(root_hist, total_g, total_h, total_c),
+                      jnp.asarray(True))
+    hists = jnp.zeros((L, F, B, 3), dtype).at[0].set(root_hist)
+    state = _GrowState(tree=tree, best=best, hists=hists,
+                       row_leaf=jnp.zeros((n,), jnp.int32),
+                       num_splits=jnp.asarray(0, jnp.int32))
+
+    def depth_ok(d):
+        if cfg.max_depth <= 0:
+            return jnp.asarray(True)
+        return d < cfg.max_depth
+
+    def do_split(state: _GrowState) -> _GrowState:
+        tree, best, hists, row_leaf, ns = state
+        leaf = jnp.argmax(best.gain).astype(jnp.int32)
+        R = ns + 1  # new (right-child) leaf slot
+        f = best.feature[leaf]
+        t = best.threshold_bin[leaf]
+        dl = best.default_left[leaf]
+
+        # -- partition rows of `leaf` (DataPartition::Split analog) --
+        col = lax.dynamic_index_in_dim(bins_T, f, axis=0,
+                                       keepdims=False).astype(jnp.int32)
+        nan_bin = feat_nan_bin[f]
+        go_left = jnp.where((nan_bin >= 0) & (col == nan_bin), dl, col <= t)
+        on_leaf = row_leaf == leaf
+        row_leaf = jnp.where(on_leaf & ~go_left, R, row_leaf)
+
+        # -- tree arrays update (Tree::Split, tree.h:63) --
+        parent = tree.leaf_parent[leaf]
+        pidx = jnp.maximum(parent, 0)
+        lc = tree.left_child
+        rc = tree.right_child
+        lc = lc.at[pidx].set(jnp.where((parent >= 0) & (lc[pidx] == ~leaf),
+                                       ns, lc[pidx]))
+        rc = rc.at[pidx].set(jnp.where((parent >= 0) & (rc[pidx] == ~leaf),
+                                       ns, rc[pidx]))
+        lc = lc.at[ns].set(~leaf)
+        rc = rc.at[ns].set(~R)
+        parent_g = best.left_sum_g[leaf] + best.right_sum_g[leaf]
+        parent_h = best.left_sum_h[leaf] + best.right_sum_h[leaf]
+        parent_c = best.left_count[leaf] + best.right_count[leaf]
+        new_depth = tree.leaf_depth[leaf] + 1
+        tree = tree._replace(
+            split_feature=tree.split_feature.at[ns].set(f),
+            threshold_bin=tree.threshold_bin.at[ns].set(t),
+            default_left=tree.default_left.at[ns].set(dl),
+            left_child=lc,
+            right_child=rc,
+            split_gain=tree.split_gain.at[ns].set(best.gain[leaf]),
+            internal_value=tree.internal_value.at[ns].set(
+                leaf_output(parent_g, parent_h, p)),
+            internal_weight=tree.internal_weight.at[ns].set(parent_h),
+            internal_count=tree.internal_count.at[ns].set(parent_c),
+            leaf_value=tree.leaf_value.at[leaf].set(best.left_output[leaf])
+            .at[R].set(best.right_output[leaf]),
+            leaf_weight=tree.leaf_weight.at[leaf].set(best.left_sum_h[leaf])
+            .at[R].set(best.right_sum_h[leaf]),
+            leaf_count=tree.leaf_count.at[leaf].set(best.left_count[leaf])
+            .at[R].set(best.right_count[leaf]),
+            leaf_parent=tree.leaf_parent.at[leaf].set(ns).at[R].set(ns),
+            leaf_depth=tree.leaf_depth.at[leaf].set(new_depth)
+            .at[R].set(new_depth),
+            num_leaves=tree.num_leaves + 1,
+        )
+
+        # -- histograms: scatter the smaller child, subtract for sibling --
+        left_smaller = best.left_count[leaf] <= best.right_count[leaf]
+        small_slot = jnp.where(left_smaller, leaf, R)
+        small_mask = row_leaf == small_slot
+        small_hist = psum(build_histogram(bins_T, grad, hess, row_weight,
+                                          small_mask, B, cfg.hist_method))
+        parent_hist = hists[leaf]
+        big_hist = subtract_histogram(parent_hist, small_hist)
+        left_hist = jnp.where(left_smaller, small_hist, big_hist)
+        right_hist = jnp.where(left_smaller, big_hist, small_hist)
+        hists = hists.at[leaf].set(left_hist).at[R].set(right_hist)
+
+        # -- child best splits --
+        can_go_deeper = depth_ok(new_depth)
+        rl = best_for(left_hist, best.left_sum_g[leaf],
+                      best.left_sum_h[leaf], best.left_count[leaf])
+        rr = best_for(right_hist, best.right_sum_g[leaf],
+                      best.right_sum_h[leaf], best.right_count[leaf])
+        best = best.store(leaf, rl, can_go_deeper)
+        best = best.store(R, rr, can_go_deeper)
+
+        return _GrowState(tree=tree, best=best, hists=hists,
+                          row_leaf=row_leaf, num_splits=ns + 1)
+
+    def step(_, state: _GrowState) -> _GrowState:
+        can = jnp.max(state.best.gain) > 0.0
+        return lax.cond(can, do_split, lambda s: s, state)
+
+    state = lax.fori_loop(0, L - 1, step, state)
+    return state.tree, state.row_leaf
+
+
+grow_tree = jax.jit(grow_tree_impl, static_argnames=("cfg",))

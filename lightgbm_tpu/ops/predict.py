@@ -1,0 +1,139 @@
+"""Batched tree traversal (prediction) as XLA gathers.
+
+Re-design of Tree::Predict / the branchy per-row traversal
+(/root/reference/include/LightGBM/tree.h:134,338-410 and
+src/boosting/gbdt_prediction.cpp) as a vectorized node-pointer iteration:
+every row walks the tree simultaneously via gathers on the flat tree
+tensors; a ``lax.while_loop`` runs until all rows hit a leaf.
+
+Missing-value routing matches the reference's NumericalDecision
+(tree.h:338-360): missing_type none -> NaN treated as 0; zero -> |v| <=
+kZeroThreshold or NaN follows the default arm; nan -> NaN follows the
+default arm (encoded in decision_type bits, see models/tree.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["predict_leaf_binned", "predict_leaf_raw", "StackedTrees"]
+
+K_ZERO_THRESHOLD = 1e-35
+
+# missing_type codes (match decision_type bits 2-3 in the model format)
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+class StackedTrees(NamedTuple):
+    """A whole forest as stacked tensors: leading axis = tree index.
+
+    Leaves are referenced as ``~leaf`` in child arrays (tree.h convention).
+    """
+    split_feature: jnp.ndarray   # [T, L-1] i32
+    threshold: jnp.ndarray       # [T, L-1] f64/f32 real-valued thresholds
+    threshold_bin: jnp.ndarray   # [T, L-1] i32
+    default_left: jnp.ndarray    # [T, L-1] bool
+    missing_type: jnp.ndarray    # [T, L-1] i8
+    is_categorical: jnp.ndarray  # [T, L-1] bool
+    cat_bitset: jnp.ndarray      # [T, L-1, W] u32 category membership bitsets
+    left_child: jnp.ndarray      # [T, L-1] i32
+    right_child: jnp.ndarray     # [T, L-1] i32
+    leaf_value: jnp.ndarray      # [T, L] f32
+
+
+def _traverse(n: int, decide_fn, left_child, right_child):
+    """Run node-pointer iteration until every row reaches a leaf."""
+    node0 = jnp.zeros((n,), jnp.int32)
+
+    def cond(node):
+        return jnp.any(node >= 0)
+
+    def body(node):
+        idx = jnp.maximum(node, 0)
+        go_left = decide_fn(idx)
+        nxt = jnp.where(go_left, left_child[idx], right_child[idx])
+        return jnp.where(node >= 0, nxt, node)
+
+    node = lax.while_loop(cond, body, node0)
+    return ~node  # leaf indices
+
+
+def predict_leaf_binned(split_feature, threshold_bin, default_left,
+                        left_child, right_child, feat_nan_bin,
+                        bins_T) -> jnp.ndarray:
+    """Leaf index per row for one tree over the *binned* matrix [F, n].
+
+    Used for train/valid score updates during boosting, where data is
+    already binned (the ScoreUpdater::AddScore analog, score_updater.hpp).
+    """
+    n = bins_T.shape[1]
+    rows = jnp.arange(n)
+
+    def decide(idx):
+        sf = split_feature[idx]
+        v = bins_T[sf, rows].astype(jnp.int32)
+        nb = feat_nan_bin[sf]
+        return jnp.where((nb >= 0) & (v == nb), default_left[idx],
+                         v <= threshold_bin[idx])
+
+    return _traverse(n, decide, left_child, right_child)
+
+
+def _cat_contains(bitset_row: jnp.ndarray, value: jnp.ndarray) -> jnp.ndarray:
+    """Test value membership in a u32 bitset (FindInBitset analog)."""
+    W = bitset_row.shape[-1]
+    word = value // 32
+    bit = value % 32
+    in_range = (value >= 0) & (word < W)
+    w = jnp.take_along_axis(bitset_row, jnp.maximum(word, 0)[..., None],
+                            axis=-1)[..., 0]
+    return in_range & ((w >> bit.astype(jnp.uint32)) & 1).astype(jnp.bool_)
+
+
+def predict_leaf_raw(tree: StackedTrees, ti: int | jnp.ndarray,
+                     X: jnp.ndarray) -> jnp.ndarray:
+    """Leaf index per row for tree ``ti`` over raw features ``[n, F]``."""
+    n = X.shape[0]
+    sf = tree.split_feature[ti]
+    thr = tree.threshold[ti]
+    dl = tree.default_left[ti]
+    mt = tree.missing_type[ti]
+    is_cat = tree.is_categorical[ti]
+    bitset = tree.cat_bitset[ti]
+
+    def decide(idx):
+        f = sf[idx]
+        v = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
+        m = mt[idx]
+        is_nan = jnp.isnan(v)
+        v0 = jnp.where(is_nan, 0.0, v)
+        # numerical decision with missing routing (tree.h:338-360)
+        is_zero = jnp.abs(v0) <= K_ZERO_THRESHOLD
+        missing = jnp.where(m == MISSING_NAN, is_nan,
+                            jnp.where(m == MISSING_ZERO, is_zero | is_nan,
+                                      jnp.zeros_like(is_nan)))
+        num_left = jnp.where(missing, dl[idx], v0 <= thr[idx])
+        # categorical decision: membership in bitset -> left (tree.h:402)
+        iv = jnp.where(is_nan | (v < 0), -1, v).astype(jnp.int32)
+        cat_left = _cat_contains(bitset[idx], iv)
+        return jnp.where(is_cat[idx], cat_left, num_left)
+
+    return _traverse(n, decide, tree.left_child[ti], tree.right_child[ti])
+
+
+def predict_forest_raw(tree: StackedTrees, X: jnp.ndarray,
+                       num_trees: int) -> jnp.ndarray:
+    """Sum of leaf values over trees [0, num_trees) -> raw scores [n]."""
+
+    def body(i, acc):
+        leaves = predict_leaf_raw(tree, i, X)
+        return acc + tree.leaf_value[i][leaves]
+
+    init = jnp.zeros((X.shape[0],), tree.leaf_value.dtype)
+    return lax.fori_loop(0, num_trees, body, init)

@@ -1,0 +1,63 @@
+"""Per-leaf output refinement (RenewTreeOutput analog).
+
+The reference's L1-family objectives re-fit each leaf's output as a
+(weighted) percentile of the residuals in that leaf
+(/root/reference/src/objective/regression_objective.hpp RenewTreeOutput /
+PercentileFun / WeightedPercentileFun). TPU re-design: one lexicographic
+sort of (leaf, residual) over all rows, then segment-wise weighted
+percentile selection — no per-leaf gather loops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["renew_leaf_values"]
+
+
+@functools.partial(jax.jit, static_argnames=("num_leaves",))
+def renew_leaf_values(row_leaf: jnp.ndarray,
+                      residual: jnp.ndarray,
+                      row_weight: jnp.ndarray,
+                      num_leaves: int,
+                      alpha: float,
+                      fallback: jnp.ndarray) -> jnp.ndarray:
+    """Weighted alpha-percentile of ``residual`` per leaf.
+
+    Args:
+      row_leaf: [n] i32 leaf assignment.
+      residual: [n] float (label - score).
+      row_weight: [n] float; rows with weight 0 (out-of-bag) are ignored.
+      num_leaves: static leaf count L.
+      alpha: percentile in (0, 1); 0.5 = median.
+      fallback: [L] values used for empty leaves.
+
+    Returns [L] refined leaf outputs.
+    """
+    n = row_leaf.shape[0]
+    active = row_weight > 0
+    # push inactive rows to a dummy segment L
+    seg = jnp.where(active, row_leaf, num_leaves)
+    order = jnp.lexsort((residual, seg))
+    seg_s = seg[order]
+    res_s = residual[order]
+    w_s = jnp.where(active, row_weight, 0.0)[order]
+
+    totals = jax.ops.segment_sum(w_s, seg_s, num_segments=num_leaves + 1)
+    cumw = jnp.cumsum(w_s)
+    seg_offsets = jnp.concatenate(
+        [jnp.zeros((1,), cumw.dtype), jnp.cumsum(totals)])[:-1]
+    cum_in_seg = cumw - seg_offsets[seg_s]
+
+    target = alpha * totals[seg_s]
+    hit = cum_in_seg >= target - 1e-12
+    # first index in each segment where the cumulative weight crosses target
+    cand = jnp.where(hit, jnp.arange(n), n)
+    first_idx = jax.ops.segment_min(cand, seg_s,
+                                    num_segments=num_leaves + 1)[:num_leaves]
+    valid = (first_idx < n) & (totals[:num_leaves] > 0)
+    vals = res_s[jnp.minimum(first_idx, n - 1)]
+    return jnp.where(valid, vals, fallback)

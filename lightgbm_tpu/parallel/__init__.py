@@ -1,0 +1,1 @@
+"""Distributed training over jax.sharding meshes."""

@@ -1,0 +1,232 @@
+"""Prediction paths (batch raw-feature inference).
+
+Re-design of the reference Predictor / GBDT::Predict stack
+(/root/reference/src/boosting/gbdt_prediction.cpp,
+src/application/predictor.hpp, c_api LGBM_BoosterPredictForMat): the whole
+forest is stacked into device tensors once (ops/predict.py StackedTrees)
+and every row traverses every tree via vectorized gathers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .models.tree import Tree
+from .ops.predict import StackedTrees, predict_leaf_raw
+
+__all__ = ["predict_any", "stack_trees"]
+
+
+def stack_trees(trees: List[Tree], dtype=jnp.float32) -> StackedTrees:
+    T = len(trees)
+    max_nodes = max((t.num_nodes for t in trees), default=0)
+    max_nodes = max(max_nodes, 1)
+    max_leaves = max((t.num_leaves for t in trees), default=1)
+    W = 1
+    for t in trees:
+        if t.num_cat > 0:
+            spans = np.diff(t.cat_boundaries)
+            W = max(W, int(spans.max()))
+
+    def pad(arr, size, fill, dt):
+        out = np.full((size,), fill, dt)
+        out[: len(arr)] = arr
+        return out
+
+    sf = np.zeros((T, max_nodes), np.int32)
+    thr = np.zeros((T, max_nodes), np.float64)
+    tb = np.zeros((T, max_nodes), np.int32)
+    dl = np.zeros((T, max_nodes), bool)
+    mt = np.zeros((T, max_nodes), np.int8)
+    ic = np.zeros((T, max_nodes), bool)
+    bits = np.zeros((T, max_nodes, W), np.uint32)
+    lc = np.full((T, max_nodes), -1, np.int32)
+    rc = np.full((T, max_nodes), -1, np.int32)
+    lv = np.zeros((T, max_leaves), np.float64)
+    for i, t in enumerate(trees):
+        nn = t.num_nodes
+        if nn > 0:
+            sf[i, :nn] = t.split_feature
+            tb[i, :nn] = t.threshold_bin
+            dl[i, :nn] = (t.decision_type & 2) != 0
+            mt[i, :nn] = (t.decision_type >> 2) & 3
+            ic[i, :nn] = (t.decision_type & 1) != 0
+            lc[i, :nn] = t.left_child
+            rc[i, :nn] = t.right_child
+            for node in range(nn):
+                if ic[i, node]:
+                    cat_idx = int(t.threshold[node])
+                    a = t.cat_boundaries[cat_idx]
+                    b = t.cat_boundaries[cat_idx + 1]
+                    bits[i, node, : b - a] = t.cat_threshold[a:b]
+                else:
+                    thr[i, node] = t.threshold[node]
+        else:
+            # stump: route every row to leaf 0
+            lc[i, 0] = -1
+            rc[i, 0] = -1
+        lv[i, : t.num_leaves] = t.leaf_value
+    # f32-safe thresholds: round DOWN to the nearest f32 so that any
+    # f32-representable feature value keeps its training-time side of the
+    # split (thresholds are f64 midpoints between adjacent values; plain
+    # round-to-nearest could land on/above the right neighbour).
+    if dtype == jnp.float32:
+        thr32 = thr.astype(np.float32)
+        bad = thr32.astype(np.float64) > thr
+        thr32[bad] = np.nextafter(thr32[bad], np.float32(-np.inf))
+        thr = thr32
+    return StackedTrees(
+        split_feature=jnp.asarray(sf),
+        threshold=jnp.asarray(thr, dtype),
+        threshold_bin=jnp.asarray(tb),
+        default_left=jnp.asarray(dl),
+        missing_type=jnp.asarray(mt),
+        is_categorical=jnp.asarray(ic),
+        cat_bitset=jnp.asarray(bits),
+        left_child=jnp.asarray(lc),
+        right_child=jnp.asarray(rc),
+        leaf_value=jnp.asarray(lv, dtype),
+    )
+
+
+def _extract_matrix(booster, data) -> np.ndarray:
+    from .basic import Dataset, LightGBMError
+    if isinstance(data, Dataset):
+        raise LightGBMError(
+            "Cannot use Dataset instance for prediction, please use raw "
+            "data instead")
+    try:
+        import pandas as pd
+        if isinstance(data, pd.DataFrame):
+            arrs = []
+            pc = booster.pandas_categorical
+            ci = 0
+            for col in data.columns:
+                s = data[col]
+                if isinstance(s.dtype, pd.CategoricalDtype):
+                    cats = None
+                    if pc is not None and ci < len(pc):
+                        cats = pc[ci]
+                    ci += 1
+                    if cats is not None:
+                        s = s.cat.set_categories(cats)
+                    codes = s.cat.codes.to_numpy().astype(np.float64)
+                    codes[codes < 0] = np.nan
+                    arrs.append(codes)
+                else:
+                    arrs.append(s.to_numpy(dtype=np.float64,
+                                           na_value=np.nan))
+            return np.column_stack(arrs)
+    except ImportError:
+        pass
+    if hasattr(data, "toarray"):
+        return np.asarray(data.todense(), np.float64)
+    X = np.asarray(data, dtype=np.float64)
+    if X.ndim == 1:
+        X = X[None, :]
+    return X
+
+
+def predict_any(booster, data, start_iteration: int = 0,
+                num_iteration: int = -1, raw_score: bool = False,
+                pred_leaf: bool = False,
+                pred_contrib: bool = False) -> np.ndarray:
+    from .basic import LightGBMError
+    X = _extract_matrix(booster, data)
+    n_feat = booster.num_feature()
+    if n_feat and X.shape[1] != n_feat:
+        raise LightGBMError(
+            f"The number of features in data ({X.shape[1]}) is not the "
+            f"same as it was in training data ({n_feat}).")
+    trees = booster._models
+    K = booster.num_model_per_iteration()
+    total_iters = len(trees) // max(K, 1)
+    if num_iteration is None or num_iteration <= 0:
+        num_iteration = total_iters - start_iteration
+    num_iteration = min(num_iteration, total_iters - start_iteration)
+    lo = start_iteration * K
+    hi = (start_iteration + num_iteration) * K
+    sel = trees[lo:hi]
+    n = X.shape[0]
+
+    if pred_contrib:
+        from .shap import predict_contrib
+        return predict_contrib(booster, X, sel, K)
+
+    if not sel:
+        out = np.zeros((n, K), np.float64)
+        return out[:, 0] if K == 1 else out
+
+    stacked = stack_trees(sel)
+    Xd = jnp.asarray(X, jnp.float32)
+
+    if pred_leaf:
+        leaves = _predict_leaves_jit(stacked, Xd, len(sel))
+        return np.asarray(leaves, np.int32)
+
+    scores = _predict_scores_jit(stacked, Xd, len(sel), K)
+    out = np.asarray(scores, np.float64)  # [n, K]
+
+    if booster._avg_output:
+        # random forest: leaves are stored unscaled (reference rf.hpp /
+        # average_output header); average over the iterations actually used
+        out = out / max(1, num_iteration)
+
+    if not raw_score:
+        out = _convert_output(booster, out)
+    return out[:, 0] if K == 1 else out
+
+
+@jax.jit
+def _forest_leaves(stacked: StackedTrees, X: jnp.ndarray) -> jnp.ndarray:
+    def per_tree(ti):
+        return predict_leaf_raw(stacked, ti, X)
+    T = stacked.leaf_value.shape[0]
+    return jax.vmap(per_tree)(jnp.arange(T))  # [T, n]
+
+
+def _predict_leaves_jit(stacked, X, T):
+    return _forest_leaves(stacked, X).T
+
+
+def _predict_scores_jit(stacked, X, T, K):
+    leaves = _forest_leaves(stacked, X)  # [T, n]
+    vals = jnp.take_along_axis(stacked.leaf_value, leaves, axis=1)  # [T, n]
+    n = X.shape[0]
+    # tree i contributes to class i % K
+    scores = jnp.zeros((K, n), vals.dtype)
+    class_of_tree = jnp.arange(T) % K
+    scores = scores.at[class_of_tree].add(vals)
+    return scores.T  # [n, K]
+
+
+def _convert_output(booster, out: np.ndarray) -> np.ndarray:
+    """Objective-specific output transform (ConvertOutput analog), driven
+    by the objective string stored in the model header."""
+    obj = (booster._objective_str or "none").split()
+    name = obj[0] if obj else "none"
+    kv = dict(t.split(":", 1) for t in obj[1:] if ":" in t)
+    if name == "binary":
+        sig = float(kv.get("sigmoid", 1.0))
+        return 1.0 / (1.0 + np.exp(-sig * out))
+    if name == "multiclass" or name == "softmax":
+        e = np.exp(out - out.max(axis=1, keepdims=True))
+        return e / e.sum(axis=1, keepdims=True)
+    if name == "multiclassova":
+        sig = float(kv.get("sigmoid", 1.0))
+        return 1.0 / (1.0 + np.exp(-sig * out))
+    if name in ("poisson", "gamma", "tweedie"):
+        return np.exp(out)
+    if name == "cross_entropy":
+        return 1.0 / (1.0 + np.exp(-out))
+    if name == "cross_entropy_lambda":
+        return np.log1p(np.exp(out))
+    if name in ("regression", "regression_l2") and "sqrt" in kv:
+        return np.sign(out) * out * out
+    return out

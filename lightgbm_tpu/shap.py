@@ -1,0 +1,178 @@
+"""SHAP feature contributions (TreeSHAP).
+
+Re-design of the reference's PredictContrib path
+(/root/reference/src/boosting/gbdt.cpp:640 and the TreeSHAP recursion in
+src/io/tree.cpp). Host-side recursive TreeSHAP over the numpy tree arrays;
+a batched device implementation is planned once the interaction surface
+stabilizes.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+__all__ = ["predict_contrib"]
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction",
+                 "pweight")
+
+    def __init__(self, feature_index=-1, zero_fraction=0.0,
+                 one_fraction=0.0, pweight=0.0):
+        self.feature_index = feature_index
+        self.zero_fraction = zero_fraction
+        self.one_fraction = one_fraction
+        self.pweight = pweight
+
+
+def _extend_path(path: List[_PathElement], unique_depth: int,
+                 zero_fraction: float, one_fraction: float,
+                 feature_index: int) -> None:
+    path[unique_depth].feature_index = feature_index
+    path[unique_depth].zero_fraction = zero_fraction
+    path[unique_depth].one_fraction = one_fraction
+    path[unique_depth].pweight = 1.0 if unique_depth == 0 else 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1) \
+            / (unique_depth + 1)
+        path[i].pweight = zero_fraction * path[i].pweight \
+            * (unique_depth - i) / (unique_depth + 1)
+
+
+def _unwind_path(path: List[_PathElement], unique_depth: int,
+                 path_index: int) -> None:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i].pweight
+            path[i].pweight = next_one_portion * (unique_depth + 1) \
+                / ((i + 1) * one_fraction)
+            next_one_portion = tmp - path[i].pweight * zero_fraction \
+                * (unique_depth - i) / (unique_depth + 1)
+        else:
+            path[i].pweight = path[i].pweight * (unique_depth + 1) \
+                / (zero_fraction * (unique_depth - i))
+    for i in range(path_index, unique_depth):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+
+
+def _unwound_path_sum(path: List[_PathElement], unique_depth: int,
+                      path_index: int) -> float:
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = next_one_portion * (unique_depth + 1) \
+                / ((i + 1) * one_fraction)
+            total += tmp
+            next_one_portion = path[i].pweight - tmp * zero_fraction \
+                * (unique_depth - i) / (unique_depth + 1)
+        else:
+            total += path[i].pweight / (
+                zero_fraction * (unique_depth - i) / (unique_depth + 1))
+    return total
+
+
+def _tree_shap(tree, x: np.ndarray, phi: np.ndarray, node: int,
+               unique_depth: int, parent_path: List[_PathElement],
+               parent_zero_fraction: float, parent_one_fraction: float,
+               parent_feature_index: int) -> None:
+    path = [
+        _PathElement(p.feature_index, p.zero_fraction, p.one_fraction,
+                     p.pweight)
+        for p in parent_path[:unique_depth]
+    ] + [_PathElement() for _ in range(tree.num_leaves + 2 - unique_depth)]
+    _extend_path(path, unique_depth, parent_zero_fraction,
+                 parent_one_fraction, parent_feature_index)
+
+    if node < 0:  # leaf
+        leaf = ~node
+        for i in range(1, unique_depth + 1):
+            w = _unwound_path_sum(path, unique_depth, i)
+            el = path[i]
+            phi[el.feature_index] += w * (el.one_fraction
+                                          - el.zero_fraction) \
+                * tree.leaf_value[leaf]
+        return
+
+    f = int(tree.split_feature[node])
+    hot, cold = _decide_children(tree, node, x[f])
+    w_node = float(tree.internal_count[node])
+    hot_count = _child_count(tree, hot)
+    cold_count = _child_count(tree, cold)
+    hot_zero_fraction = hot_count / w_node if w_node > 0 else 0.0
+    cold_zero_fraction = cold_count / w_node if w_node > 0 else 0.0
+    incoming_zero_fraction = 1.0
+    incoming_one_fraction = 1.0
+    # undo re-used feature occurrences further up the path
+    path_index = 0
+    while path_index <= unique_depth:
+        if path[path_index].feature_index == f:
+            break
+        path_index += 1
+    if path_index != unique_depth + 1:
+        incoming_zero_fraction = path[path_index].zero_fraction
+        incoming_one_fraction = path[path_index].one_fraction
+        _unwind_path(path, unique_depth, path_index)
+        unique_depth -= 1
+
+    _tree_shap(tree, x, phi, hot, unique_depth + 1, path,
+               hot_zero_fraction * incoming_zero_fraction,
+               incoming_one_fraction, f)
+    _tree_shap(tree, x, phi, cold, unique_depth + 1, path,
+               cold_zero_fraction * incoming_zero_fraction, 0.0, f)
+
+
+def _child_count(tree, node: int) -> float:
+    if node < 0:
+        return float(tree.leaf_count[~node])
+    return float(tree.internal_count[node])
+
+
+def _decide_children(tree, node: int, v: float):
+    if tree.is_categorical_node(node):
+        go_left = tree._cat_decision(node, v)
+    else:
+        go_left = tree._num_decision(node, v)
+    l, r = int(tree.left_child[node]), int(tree.right_child[node])
+    return (l, r) if go_left else (r, l)
+
+
+def _expected_value(tree) -> float:
+    if tree.num_leaves == 1:
+        return float(tree.leaf_value[0])
+    total = float(tree.internal_count[0])
+    if total <= 0:
+        return 0.0
+    return float(np.sum(tree.leaf_value[: tree.num_leaves]
+                        * tree.leaf_count[: tree.num_leaves]) / total)
+
+
+def predict_contrib(booster, X: np.ndarray, trees, K: int) -> np.ndarray:
+    """Per-feature SHAP values + expected-value column, shape
+    [n, (F+1)*K] matching LGBM_BoosterPredictForMat contrib layout."""
+    n, _ = X.shape
+    F = booster.num_feature()
+    out = np.zeros((n, (F + 1) * K), np.float64)
+    for ti, tree in enumerate(trees):
+        k = ti % K
+        base = k * (F + 1)
+        if tree.num_leaves <= 1:
+            out[:, base + F] += float(tree.leaf_value[0])
+            continue
+        ev = _expected_value(tree)
+        for r in range(n):
+            phi = np.zeros(F + 1, np.float64)
+            _tree_shap(tree, X[r], phi, 0, 0, [], 1.0, 1.0, -1)
+            phi[F] += ev
+            out[r, base: base + F + 1] += phi
+    return out

@@ -1,0 +1,65 @@
+"""Logging (Log singleton analog, /root/reference/include/LightGBM/utils/log.h:88).
+
+Levels Fatal/Warning/Info/Debug with a registerable callback, mirroring
+``LGBM_RegisterLogCallback`` (c_api.h:73) / the python-package's
+``register_logger``.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Callable, Optional
+
+__all__ = ["log_debug", "log_info", "log_warning", "LightGBMError",
+           "register_logger", "set_verbosity"]
+
+_logger: Optional[logging.Logger] = None
+_info_method = "info"
+_warning_method = "warning"
+_verbosity = 1
+
+
+def _default_logger() -> logging.Logger:
+    logger = logging.getLogger("lightgbm_tpu")
+    if not logger.handlers:
+        h = logging.StreamHandler(sys.stdout)
+        h.setFormatter(logging.Formatter("%(message)s"))
+        logger.addHandler(h)
+        logger.setLevel(logging.INFO)
+    return logger
+
+
+def register_logger(logger: logging.Logger, info_method_name: str = "info",
+                    warning_method_name: str = "warning") -> None:
+    global _logger, _info_method, _warning_method
+    _logger = logger
+    _info_method = info_method_name
+    _warning_method = warning_method_name
+
+
+def set_verbosity(v: int) -> None:
+    global _verbosity
+    _verbosity = v
+
+
+def log_debug(msg: str) -> None:
+    if _verbosity >= 2:
+        getattr(_logger or _default_logger(), _info_method)(
+            f"[LightGBM-TPU] [Debug] {msg}")
+
+
+def log_info(msg: str) -> None:
+    if _verbosity >= 1:
+        getattr(_logger or _default_logger(), _info_method)(
+            f"[LightGBM-TPU] [Info] {msg}")
+
+
+def log_warning(msg: str) -> None:
+    if _verbosity >= 0:
+        getattr(_logger or _default_logger(), _warning_method)(
+            f"[LightGBM-TPU] [Warning] {msg}")
+
+
+class LightGBMError(Exception):
+    pass

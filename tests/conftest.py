@@ -1,0 +1,43 @@
+"""Test configuration: force an 8-virtual-device CPU mesh.
+
+Mirrors the reference's localhost-cluster test pattern
+(tests/distributed/_test_distributed.py): multi-node is simulated on one
+host — here via XLA's host-platform device partitioning instead of
+loopback TCP sockets.
+"""
+
+import os
+
+# Force CPU: the ambient environment may point JAX_PLATFORMS at a remote
+# TPU tunnel, which would run every test over per-op RTT.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.RandomState(42)
+
+
+def make_synthetic_binary(n=2000, f=10, seed=7):
+    """Linearly-separable-ish binary task with noise."""
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    coef = rs.randn(f)
+    logits = X @ coef + 0.5 * rs.randn(n)
+    y = (logits > 0).astype(np.float64)
+    return X, y
+
+
+def make_synthetic_regression(n=2000, f=10, seed=7):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    coef = rs.randn(f)
+    y = X @ coef + 0.1 * rs.randn(n)
+    return X, y

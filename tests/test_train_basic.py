@@ -1,0 +1,113 @@
+"""End-to-end training smoke tests (the minimum slice of SURVEY.md §7)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+from conftest import make_synthetic_binary, make_synthetic_regression
+
+
+def test_binary_end_to_end():
+    X, y = make_synthetic_binary(n=2000, f=10)
+    train = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 15, "learning_rate": 0.1,
+         "metric": ["binary_logloss", "auc"], "verbosity": -1,
+         "min_data_in_leaf": 5},
+        train, num_boost_round=20)
+    assert bst.current_iteration() == 20
+    pred = bst.predict(X)
+    assert pred.shape == (2000,)
+    assert np.all((pred >= 0) & (pred <= 1))
+    acc = np.mean((pred > 0.5) == (y > 0))
+    assert acc > 0.9, f"accuracy too low: {acc}"
+
+
+def test_binary_eval_improves():
+    X, y = make_synthetic_binary(n=3000, f=8, seed=3)
+    Xtr, ytr = X[:2000], y[:2000]
+    Xva, yva = X[2000:], y[2000:]
+    train = lgb.Dataset(Xtr, label=ytr)
+    valid = train.create_valid(Xva, label=yva)
+    record = {}
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 31, "metric": "binary_logloss",
+         "verbosity": -1},
+        train, num_boost_round=30, valid_sets=[valid],
+        callbacks=[lgb.record_evaluation(record)])
+    ll = record["valid_0"]["binary_logloss"]
+    assert ll[-1] < ll[0] * 0.7, f"logloss did not improve: {ll[0]} -> {ll[-1]}"
+    assert ll[-1] < 0.45
+
+
+def test_regression_l2():
+    X, y = make_synthetic_regression(n=2000, f=10)
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 31, "metric": "l2",
+         "verbosity": -1},
+        train, num_boost_round=50)
+    pred = bst.predict(X)
+    mse = np.mean((pred - y) ** 2)
+    var = np.var(y)
+    assert mse < 0.2 * var, f"mse {mse} vs var {var}"
+
+
+def test_predict_matches_internal_score():
+    X, y = make_synthetic_binary(n=1000, f=6, seed=11)
+    train = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        train, num_boost_round=10)
+    raw = bst.predict(X, raw_score=True)
+    internal = bst._engine.current_score(0)[0]
+    np.testing.assert_allclose(raw, internal, rtol=1e-4, atol=1e-5)
+
+
+def test_model_save_load_roundtrip(tmp_path):
+    X, y = make_synthetic_binary(n=1000, f=6, seed=5)
+    train = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "binary", "num_leaves": 7, "verbosity": -1},
+        train, num_boost_round=5)
+    pred0 = bst.predict(X)
+    path = tmp_path / "model.txt"
+    bst.save_model(str(path))
+    bst2 = lgb.Booster(model_file=str(path))
+    pred1 = bst2.predict(X)
+    np.testing.assert_allclose(pred0, pred1, rtol=1e-6)
+    # round-trip the string form too
+    s = bst2.model_to_string()
+    bst3 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(pred0, bst3.predict(X), rtol=1e-6)
+
+
+def test_early_stopping():
+    X, y = make_synthetic_binary(n=3000, f=8, seed=13)
+    train = lgb.Dataset(X[:2000], label=y[:2000])
+    valid = train.create_valid(X[2000:], label=y[2000:])
+    bst = lgb.train(
+        {"objective": "binary", "metric": "binary_logloss",
+         "verbosity": -1},
+        train, num_boost_round=500, valid_sets=[valid],
+        callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert bst.best_iteration < 500
+
+
+def test_multiclass():
+    rs = np.random.RandomState(0)
+    n, f, k = 1500, 8, 3
+    X = rs.randn(n, f)
+    centers = rs.randn(k, f) * 2
+    y = np.argmin(((X[:, None, :] - centers[None]) ** 2).sum(-1), axis=1)
+    train = lgb.Dataset(X, label=y.astype(np.float64), free_raw_data=False)
+    bst = lgb.train(
+        {"objective": "multiclass", "num_class": 3, "num_leaves": 15,
+         "verbosity": -1},
+        train, num_boost_round=20)
+    pred = bst.predict(X)
+    assert pred.shape == (n, k)
+    np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-4)
+    acc = np.mean(pred.argmax(axis=1) == y)
+    assert acc > 0.85, acc
