@@ -531,6 +531,13 @@ class Booster:
             return "lambdarank"
         return o
 
+    def _preload(self, base: "Booster") -> None:
+        """Adopt an existing model's trees for continued training
+        (init_model semantics, reference engine.py/basic.py)."""
+        import copy as _copy
+        trees = [_copy.deepcopy(t) for t in base._models]
+        self._engine.preload_models(trees)
+
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         data.construct()
         self._engine.add_valid(data, name)
@@ -681,6 +688,127 @@ class Booster:
     def trees_to_dataframe(self):
         from .models.model_io import trees_to_dataframe
         return trees_to_dataframe(self)
+
+    # -- misc reference-API methods ---------------------------------------
+    def set_train_data_name(self, name: str) -> "Booster":
+        self._train_data_name = name
+        return self
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """Re-apply tunable params mid-training (LGBM_BoosterResetParameter;
+        learning_rate takes effect on the next iteration)."""
+        self.params = {**self.params, **params}
+        if self._engine is not None:
+            if "learning_rate" in params:
+                self._engine._shrinkage = float(params["learning_rate"])
+            for k in ("bagging_fraction", "bagging_freq",
+                      "feature_fraction", "feature_fraction_bynode"):
+                if k in params:
+                    setattr(self._engine.cfg, k, params[k])
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        return float(self._models[tree_id].leaf_value[leaf_id])
+
+    def set_leaf_output(self, tree_id: int, leaf_id: int,
+                        value: float) -> "Booster":
+        self._models[tree_id].leaf_value[leaf_id] = value
+        return self
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Randomly permute tree order in [start, end) iterations
+        (LGBM_BoosterShuffleModels)."""
+        models = self._models
+        K = self.num_model_per_iteration()
+        n_iters = len(models) // K
+        end = n_iters if end_iteration < 0 else min(end_iteration, n_iters)
+        idx = np.arange(start_iteration, end)
+        np.random.shuffle(idx)
+        order = list(range(n_iters))
+        order[start_iteration:end] = idx.tolist()
+        reordered = []
+        for it in order:
+            reordered.extend(models[it * K: (it + 1) * K])
+        models[:] = reordered
+        return self
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of split thresholds used for a feature
+        (basic.py get_split_value_histogram analog)."""
+        if isinstance(feature, str):
+            fidx = self.feature_name().index(feature)
+        else:
+            fidx = int(feature)
+        values = []
+        for t in self._models:
+            for i in range(t.num_nodes):
+                if int(t.split_feature[i]) == fidx \
+                        and not t.is_categorical_node(i):
+                    values.append(float(t.threshold[i]))
+        hist, bin_edges = np.histogram(values, bins=bins or "auto")
+        if xgboost_style:
+            import pandas as pd
+            ret = np.column_stack((bin_edges[1:], hist))
+            ret = ret[ret[:, 1] > 0]
+            return pd.DataFrame(ret, columns=["SplitValue", "Count"])
+        return hist, bin_edges
+
+    def refit(self, data, label, decay_rate: float = 0.9, weight=None,
+              **kwargs) -> "Booster":
+        """Refit leaf values on new data keeping tree structures
+        (reference basic.py refit -> LGBM_BoosterRefit / GBDT::RefitTree:
+        new_leaf = decay*old + (1-decay)*fit, trees processed in boosting
+        order so later trees see refreshed scores)."""
+        if not self._models:
+            raise LightGBMError("Cannot refit an empty model")
+        new_bst = self.__deepcopy__(None)
+        X = np.asarray(data, np.float64)
+        y = np.asarray(label, np.float64).ravel()
+        w = None if weight is None else np.asarray(weight, np.float64)
+        leaves = self.predict(X, pred_leaf=True)  # [n, T]
+        if leaves.ndim == 1:
+            leaves = leaves[:, None]
+        cfg = self._cfg or Config.from_params(self.params)
+        from .objectives import create_objective
+        obj_cfg = Config.from_params(
+            {**self.params, "objective": (self._objective_str or
+                                          "regression").split()[0]})
+        objective = create_objective(obj_cfg)
+        if objective is None:
+            raise LightGBMError("Cannot refit without a built-in objective")
+        if hasattr(objective, "init_label_weights"):
+            objective.init_label_weights(y, w)
+        K = self.num_model_per_iteration()
+        n = len(y)
+        score = np.zeros((K, n), np.float64)
+        lam = cfg.lambda_l2
+        shrink = cfg.learning_rate
+        for ti, tree in enumerate(new_bst._models):
+            k = ti % K
+            g, h = objective.grad_hess(
+                np.asarray(score[0] if K == 1 else score, np.float32),
+                np.asarray(y, np.float32),
+                None if w is None else np.asarray(w, np.float32))
+            g = np.asarray(g, np.float64).reshape(K, n)[k] if K > 1 \
+                else np.asarray(g, np.float64).ravel()
+            h = np.asarray(h, np.float64).reshape(K, n)[k] if K > 1 \
+                else np.asarray(h, np.float64).ravel()
+            lv = leaves[:, ti]
+            L = tree.num_leaves
+            sg = np.bincount(lv, weights=g, minlength=L)
+            sh = np.bincount(lv, weights=h, minlength=L)
+            fit = -sg / (sh + lam)
+            fit = fit * shrink
+            tree.leaf_value = decay_rate * tree.leaf_value \
+                + (1.0 - decay_rate) * fit
+            score[k] += tree.leaf_value[lv]
+        return new_bst
+
+    def free_dataset(self) -> "Booster":
+        self.train_set = None
+        return self
 
     def __copy__(self):
         return self.__deepcopy__(None)

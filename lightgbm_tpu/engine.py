@@ -9,6 +9,7 @@ bookkeeping match the reference semantics.
 from __future__ import annotations
 
 import copy
+from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
@@ -41,14 +42,20 @@ def train(params: Dict[str, Any], train_set: Dataset,
         raise LightGBMError(
             "objective=none requires a custom objective function (fobj)")
 
-    if init_model is not None:
-        raise LightGBMError(
-            "Continued training (init_model) is not supported yet")
-
     if not isinstance(train_set, Dataset):
         raise TypeError("train() only accepts Dataset object(s)")
 
     booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        # continued training (engine.py init_model -> num_init_iteration)
+        if isinstance(init_model, (str, Path)):
+            base = Booster(model_file=str(init_model))
+        elif isinstance(init_model, Booster):
+            base = init_model
+        else:
+            raise TypeError(
+                "init_model should be a str, pathlib.Path or Booster")
+        booster._preload(base)
     valid_sets = valid_sets or []
     is_valid_contain_train = False
     train_data_name = "training"

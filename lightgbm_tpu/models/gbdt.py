@@ -164,6 +164,18 @@ class GBDTBooster:
         self._tree_weights: List[float] = []  # per-model weight (DART/RF)
 
     # ------------------------------------------------------------------
+    def preload_models(self, trees: List[Tree]) -> None:
+        """Continue training from an existing model (the reference's
+        init_model / num_init_iteration path, gbdt.cpp Init +
+        boosting.h:307): adopt the trees and rebuild the train score by
+        binned traversal. boost_from_average stays un-refolded because
+        iteration indices continue past 0."""
+        self.models = list(trees)
+        self._tree_weights = [1.0] * len(self.models)
+        self.iter_ = len(self.models) // self.K
+        self.score = self._score_dataset_binned(self.train_set)
+
+    # ------------------------------------------------------------------
     def add_valid(self, dataset, name: str) -> None:
         score = self._score_dataset_binned(dataset)
         self.valid_sets.append(_ValidData(dataset, score, name))
@@ -191,6 +203,24 @@ class GBDTBooster:
             score = score / self.iter_
         return score
 
+    def _binned_thresholds(self, tree: Tree) -> np.ndarray:
+        """Re-derive bin-space thresholds for a tree loaded from a model
+        file (threshold_bin is only carried in memory). Numerical nodes
+        map the real threshold onto the current binning; categorical nodes
+        reconstruct the left-set bin prefix from the bitset."""
+        inner = self.train_set.inner_feature_index(tree.split_feature)
+        tb = np.zeros(tree.num_nodes, np.int32)
+        for i in range(tree.num_nodes):
+            m = self.train_set.mappers[inner[i]]
+            if tree.is_categorical_node(i):
+                member = [b for b in range(len(m.bin_to_cat))
+                          if tree._cat_decision(i, float(m.bin_to_cat[b]))]
+                tb[i] = max(member) if member else -1
+            else:
+                tb[i] = int(np.searchsorted(m.upper_bounds,
+                                            tree.threshold[i], side="left"))
+        return tb
+
     def _predict_tree_binned_host(self, tree: Tree,
                                   bins_T: jnp.ndarray) -> jnp.ndarray:
         if tree.num_leaves <= 1:
@@ -200,8 +230,7 @@ class GBDTBooster:
         inner = self.train_set.inner_feature_index(tree.split_feature)
         tb = tree.threshold_bin
         if (tb < 0).any():
-            tb = self.train_set.thresholds_to_bins(tree.split_feature,
-                                                   tree.threshold)
+            tb = self._binned_thresholds(tree)
         # pad to the configured num_leaves so the jitted traversal
         # compiles once per dataset, not once per tree
         L = max(self.cfg.num_leaves, tree.num_leaves)

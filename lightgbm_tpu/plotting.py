@@ -1,0 +1,335 @@
+"""Plotting utilities.
+
+Re-design of the reference python-package/lightgbm/plotting.py
+(plot_importance, plot_split_value_histogram, plot_metric, plot_tree,
+create_tree_digraph) for the TPU-native booster. matplotlib is imported
+lazily; graphviz is optional (ImportError raised at call time, matching
+the reference's behavior).
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster, LightGBMError
+
+__all__ = ["plot_importance", "plot_split_value_histogram", "plot_metric",
+           "plot_tree", "create_tree_digraph"]
+
+
+def _check_not_tuple_of_2_elements(obj, obj_name: str) -> None:
+    if not isinstance(obj, tuple) or len(obj) != 2:
+        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+
+
+def _to_booster(booster) -> Booster:
+    if isinstance(booster, Booster):
+        return booster
+    if hasattr(booster, "booster_"):
+        return booster.booster_
+    raise TypeError("booster must be Booster or fitted LGBMModel.")
+
+
+def plot_importance(booster, ax=None, height: float = 0.2,
+                    xlim: Optional[Tuple] = None,
+                    ylim: Optional[Tuple] = None,
+                    title: str = "Feature importance",
+                    xlabel: str = "Feature importance",
+                    ylabel: str = "Features",
+                    importance_type: str = "auto",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None,
+                    grid: bool = True, precision: Optional[int] = 3,
+                    **kwargs):
+    """Horizontal bar plot of feature importances
+    (reference plotting.py plot_importance)."""
+    import matplotlib.pyplot as plt
+
+    bst = _to_booster(booster)
+    if importance_type == "auto":
+        importance_type = getattr(booster, "importance_type", "split")
+    importance = bst.feature_importance(importance_type=importance_type)
+    feature_name = bst.feature_name()
+
+    if not len(importance):
+        raise ValueError("Booster's feature_importance is empty.")
+
+    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    if ignore_zero:
+        tuples = [x for x in tuples if x[1] > 0]
+    if max_num_features is not None and max_num_features > 0:
+        tuples = tuples[-max_num_features:]
+    labels, values = zip(*tuples) if tuples else ((), ())
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+
+    ylocs = np.arange(len(values))
+    ax.barh(ylocs, values, align="center", height=height, **kwargs)
+    for x, y in zip(values, ylocs):
+        if importance_type == "gain" and precision is not None:
+            ax.text(x + 1, y, f"{x:.{precision}f}", va="center")
+        else:
+            ax.text(x + 1, y, str(x), va="center")
+    ax.set_yticks(ylocs)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, max(values) * 1.1 if values else 1)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (-1, len(values))
+    ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster, feature, bins=None, ax=None,
+                               width_coef: float = 0.8,
+                               xlim: Optional[Tuple] = None,
+                               ylim: Optional[Tuple] = None,
+                               title: Optional[str] = "Split value histogram "
+                               "for feature with @index/name@ @feature@",
+                               xlabel: Optional[str] = "Feature split value",
+                               ylabel: Optional[str] = "Count",
+                               figsize=None, dpi=None, grid: bool = True,
+                               **kwargs):
+    """Histogram of a feature's split thresholds across the model
+    (reference plotting.py plot_split_value_histogram)."""
+    import matplotlib.pyplot as plt
+
+    bst = _to_booster(booster)
+    names = bst.feature_name()
+    if isinstance(feature, str):
+        fidx = names.index(feature)
+    else:
+        fidx = int(feature)
+    values = []
+    for tree in bst._models:
+        for node in range(tree.num_nodes):
+            if tree.split_feature[node] == fidx \
+                    and not tree.is_categorical_node(node):
+                values.append(tree.threshold[node])
+    if not values:
+        raise ValueError(
+            "Cannot plot split value histogram, "
+            f"because feature {feature} was not used in splitting")
+    hist, bin_edges = np.histogram(values, bins=bins or "auto")
+    centers = (bin_edges[:-1] + bin_edges[1:]) / 2.0
+    widths = width_coef * np.diff(bin_edges)
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.bar(centers, hist, width=widths, align="center", **kwargs)
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        ylim = (0, max(hist) * 1.1)
+    ax.set_ylim(ylim)
+    if title is not None:
+        title = title.replace("@feature@", str(feature)).replace(
+            "@index/name@", "name" if isinstance(feature, str) else "index")
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster, metric: Optional[str] = None,
+                dataset_names: Optional[List[str]] = None, ax=None,
+                xlim: Optional[Tuple] = None, ylim: Optional[Tuple] = None,
+                title: Optional[str] = "Metric during training",
+                xlabel: Optional[str] = "Iterations",
+                ylabel: Optional[str] = "@metric@", figsize=None, dpi=None,
+                grid: bool = True):
+    """Plot metric curves from a record_evaluation dict or fitted sklearn
+    estimator (reference plotting.py plot_metric)."""
+    import matplotlib.pyplot as plt
+
+    if isinstance(booster, dict):
+        eval_results = deepcopy(booster)
+    elif hasattr(booster, "evals_result_"):
+        eval_results = deepcopy(booster.evals_result_)
+    else:
+        raise TypeError(
+            "booster must be dict (from record_evaluation) or LGBMModel")
+    if not eval_results:
+        raise ValueError("eval results cannot be empty.")
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+
+    if dataset_names is None:
+        dataset_names_iter = iter(eval_results.keys())
+    else:
+        dataset_names_iter = iter(dataset_names)
+
+    name = next(dataset_names_iter)
+    metrics_for_one = eval_results[name]
+    num_metric = len(metrics_for_one)
+    if metric is None:
+        if num_metric > 1:
+            raise ValueError(
+                "more than one metric available, pick one with the "
+                "'metric' parameter")
+        metric, results = metrics_for_one.popitem()
+    else:
+        if metric not in metrics_for_one:
+            raise ValueError("No given metric in eval results.")
+        results = metrics_for_one[metric]
+    num_iteration = len(results)
+    max_result = max(results)
+    min_result = min(results)
+    x_ = range(num_iteration)
+    ax.plot(x_, results, label=name)
+
+    for name in dataset_names_iter:
+        if name not in eval_results:
+            continue
+        results = eval_results[name][metric]
+        max_result = max(max(results), max_result)
+        min_result = min(min(results), min_result)
+        ax.plot(range(len(results)), results, label=name)
+
+    ax.legend(loc="best")
+    if xlim is not None:
+        _check_not_tuple_of_2_elements(xlim, "xlim")
+    else:
+        xlim = (0, num_iteration)
+    ax.set_xlim(xlim)
+    if ylim is not None:
+        _check_not_tuple_of_2_elements(ylim, "ylim")
+    else:
+        margin = 0.05 * (max_result - min_result + 1e-12)
+        ylim = (min_result - margin, max_result + margin)
+    ax.set_ylim(ylim)
+    if ylabel is not None:
+        ylabel = ylabel.replace("@metric@", metric)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def _tree_label(tree, node: int, is_leaf: bool, show_info: List[str],
+                precision: int, feature_names: List[str]) -> str:
+    if is_leaf:
+        parts = [f"leaf {node}",
+                 f"value: {tree.leaf_value[node]:.{precision}f}"]
+        if "leaf_count" in show_info:
+            parts.append(f"count: {int(tree.leaf_count[node])}")
+        if "leaf_weight" in show_info:
+            parts.append(f"weight: {tree.leaf_weight[node]:.{precision}f}")
+        return "\n".join(parts)
+    f = tree.split_feature[node]
+    fname = feature_names[f] if f < len(feature_names) else f"f{f}"
+    if tree.is_categorical_node(node):
+        dec = f"{fname} in categories"
+    else:
+        dec = f"{fname} <= {tree.threshold[node]:.{precision}f}"
+    parts = [dec]
+    if "split_gain" in show_info:
+        parts.append(f"gain: {tree.split_gain[node]:.{precision}f}")
+    if "internal_value" in show_info:
+        parts.append(f"value: {tree.internal_value[node]:.{precision}f}")
+    if "internal_count" in show_info:
+        parts.append(f"count: {int(tree.internal_count[node])}")
+    return "\n".join(parts)
+
+
+def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
+                        precision: Optional[int] = 3,
+                        orientation: str = "horizontal", **kwargs):
+    """Build a graphviz Digraph of one tree
+    (reference plotting.py create_tree_digraph)."""
+    try:
+        from graphviz import Digraph
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "You must install graphviz and restart your session to "
+            "plot tree.") from e
+
+    bst = _to_booster(booster)
+    if tree_index < 0 or tree_index >= len(bst._models):
+        raise IndexError("tree_index is out of range.")
+    tree = bst._models[tree_index]
+    feature_names = bst.feature_name()
+    show_info = show_info or []
+    precision = 3 if precision is None else precision
+
+    graph = Digraph(**kwargs)
+    rankdir = "LR" if orientation == "horizontal" else "TB"
+    graph.attr("graph", nodesep="0.05", ranksep="0.3", rankdir=rankdir)
+
+    def add(node: int, parent: Optional[str]) -> None:
+        if node < 0:  # leaf
+            leaf = ~node
+            name = f"leaf{leaf}"
+            graph.node(name, _tree_label(tree, leaf, True, show_info,
+                                         precision, feature_names))
+        else:
+            name = f"split{node}"
+            graph.node(name, _tree_label(tree, node, False, show_info,
+                                         precision, feature_names))
+            add(int(tree.left_child[node]), name)
+            add(int(tree.right_child[node]), name)
+        if parent is not None:
+            graph.edge(parent, name)
+
+    if tree.num_leaves <= 1:
+        graph.node("leaf0", _tree_label(tree, 0, True, show_info,
+                                        precision, feature_names))
+    else:
+        add(0, None)
+    return graph
+
+
+def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None, dpi=None,
+              show_info=None, precision: Optional[int] = 3,
+              orientation: str = "horizontal", **kwargs):
+    """Render one tree with matplotlib via graphviz
+    (reference plotting.py plot_tree)."""
+    import matplotlib.image as mpimg
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        if figsize is not None:
+            _check_not_tuple_of_2_elements(figsize, "figsize")
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    graph = create_tree_digraph(booster, tree_index=tree_index,
+                                show_info=show_info, precision=precision,
+                                orientation=orientation, **kwargs)
+    from io import BytesIO
+    s = BytesIO(graph.pipe(format="png"))
+    img = mpimg.imread(s)
+    ax.imshow(img)
+    ax.axis("off")
+    return ax

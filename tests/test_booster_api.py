@@ -1,0 +1,155 @@
+"""Booster API breadth: categorical splits, missing handling, rf,
+continued training, refit, plotting (model: reference
+tests/python_package_test/test_engine.py / test_basic.py)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from tests.conftest import make_synthetic_binary
+
+
+def _logloss(p, y):
+    p = np.clip(p, 1e-9, 1 - 1e-9)
+    return -np.mean(y * np.log(p) + (1 - y) * np.log(1 - p))
+
+
+def test_categorical_feature_roundtrip(tmp_path):
+    rs = np.random.RandomState(3)
+    n = 600
+    X = np.column_stack([rs.randint(0, 8, n).astype(float), rs.randn(n)])
+    y = (np.isin(X[:, 0], [1, 3, 5]).astype(float) * 2 + 0.3 * X[:, 1]
+         + 0.2 * rs.randn(n) > 1).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1, "min_data_in_leaf": 5},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=10)
+    pred = bst.predict(X)
+    assert ((pred > 0.5) == y).mean() > 0.85
+    f = tmp_path / "cat.txt"
+    bst.save_model(str(f))
+    assert "cat_threshold" in f.read_text()
+    pred2 = lgb.Booster(model_file=str(f)).predict(X)
+    np.testing.assert_allclose(pred, pred2, atol=1e-6)
+
+
+def test_zero_as_missing_consistency():
+    rs = np.random.RandomState(4)
+    X = rs.randn(800, 3)
+    mask = rs.rand(800) < 0.4
+    X[mask, 0] = 0.0
+    y = np.where(mask, 0.0, 3.0 * X[:, 0]) + 0.05 * rs.randn(800)
+    bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                     "verbose": -1, "zero_as_missing": True},
+                    lgb.Dataset(X, label=y), num_boost_round=20)
+    pred = bst.predict(X)
+    assert np.mean((pred[mask] - y[mask]) ** 2) < 0.1
+
+
+def test_constant_label_boost_from_average(tmp_path):
+    rs = np.random.RandomState(0)
+    X = rs.randn(200, 4)
+    bst = lgb.train({"objective": "regression", "verbose": -1},
+                    lgb.Dataset(X, label=np.full(200, 5.0)),
+                    num_boost_round=2)
+    np.testing.assert_allclose(bst.predict(X[:5]), 5.0)
+    f = tmp_path / "const.txt"
+    bst.save_model(str(f))
+    np.testing.assert_allclose(
+        lgb.Booster(model_file=str(f)).predict(X[:5]), 5.0)
+
+
+def test_rf_mode_save_load(tmp_path):
+    X, y = make_synthetic_binary(n=900, f=8)
+    dtrain = lgb.Dataset(X[:700], label=y[:700])
+    dvalid = lgb.Dataset(X[700:], label=y[700:], reference=dtrain)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "boosting": "rf",
+                     "bagging_freq": 1, "bagging_fraction": 0.7,
+                     "num_leaves": 15, "verbose": -1,
+                     "metric": "binary_logloss"},
+                    dtrain, num_boost_round=4, valid_sets=[dvalid],
+                    callbacks=[lgb.record_evaluation(evals)])
+    pred = bst.predict(X[700:])
+    # recorded valid metric must match metric recomputed from predict()
+    assert abs(evals["valid_0"]["binary_logloss"][-1]
+               - _logloss(pred, y[700:])) < 1e-3
+    f = tmp_path / "rf.txt"
+    bst.save_model(str(f))
+    assert "average_output" in f.read_text()
+    np.testing.assert_allclose(
+        lgb.Booster(model_file=str(f)).predict(X[700:]), pred, atol=1e-6)
+
+
+def test_continued_training(tmp_path):
+    X, y = make_synthetic_binary(n=700, f=6)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1}
+    b10 = lgb.train(params, lgb.Dataset(X, label=y), 6)
+    f = tmp_path / "m.txt"
+    b10.save_model(str(f))
+    cont = lgb.train(params, lgb.Dataset(X, label=y), 6,
+                     init_model=str(f))
+    scratch = lgb.train(params, lgb.Dataset(X, label=y), 12)
+    assert cont.num_trees() == 12
+    assert abs(_logloss(cont.predict(X), y)
+               - _logloss(scratch.predict(X), y)) < 0.02
+    # in-memory Booster as init_model
+    cont2 = lgb.train(params, lgb.Dataset(X, label=y), 3, init_model=b10)
+    assert cont2.num_trees() == 9
+
+
+def test_refit_adapts_to_new_labels():
+    X, y = make_synthetic_binary(n=500, f=5)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1}, lgb.Dataset(X, label=y), 8)
+    flipped = 1.0 - y
+    refitted = bst.refit(X, flipped, decay_rate=0.0)
+    assert _logloss(refitted.predict(X), flipped) < 0.5
+    assert _logloss(bst.predict(X), flipped) > 1.0
+    # same-data refit keeps quality
+    same = bst.refit(X, y, decay_rate=0.0)
+    assert abs(_logloss(same.predict(X), y)
+               - _logloss(bst.predict(X), y)) < 1e-3
+
+
+def test_cv_stratified_seed_changes_folds():
+    X, y = make_synthetic_binary(n=600, f=5)
+    params = {"objective": "binary", "num_leaves": 7, "verbose": -1}
+    r1 = lgb.cv(params, lgb.Dataset(X, label=y), num_boost_round=3,
+                nfold=3, seed=1)
+    r2 = lgb.cv(params, lgb.Dataset(X, label=y), num_boost_round=3,
+                nfold=3, seed=2)
+    key = list(r1.keys())[0]
+    assert r1[key][-1] != r2[key][-1]
+
+
+def test_plotting_smoke():
+    import matplotlib
+    matplotlib.use("Agg")
+    X, y = make_synthetic_binary(n=300, f=5)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "metric": "auc", "verbose": -1},
+                    lgb.Dataset(X, label=y), 5,
+                    valid_sets=[lgb.Dataset(X[:100], label=y[:100])],
+                    callbacks=[lgb.record_evaluation(evals)])
+    assert lgb.plot_importance(bst) is not None
+    assert lgb.plot_metric(evals) is not None
+    used = int(np.argmax(bst.feature_importance()))
+    assert lgb.plot_split_value_histogram(bst, used) is not None
+
+
+def test_predict_wrong_feature_count_raises():
+    X, y = make_synthetic_binary(n=200, f=5)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1}, lgb.Dataset(X, label=y), 2)
+    with pytest.raises(lgb.LightGBMError):
+        bst.predict(np.zeros((3, 9)))
+
+
+def test_zero_boost_rounds():
+    X, y = make_synthetic_binary(n=200, f=5)
+    bst = lgb.train({"objective": "regression", "verbose": -1},
+                    lgb.Dataset(X, label=y), 0)
+    assert bst.num_trees() == 0
+    np.testing.assert_allclose(bst.predict(X[:3]), 0.0)

@@ -1,0 +1,74 @@
+"""sklearn-wrapper behavior (model: reference tests/python_package_test/
+test_sklearn.py — estimator compliance, eval sets, fitted attributes)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from tests.conftest import make_synthetic_binary, make_synthetic_regression
+
+
+def test_classifier_string_labels():
+    X, y = make_synthetic_binary(n=600, f=6)
+    ylab = np.where(y > 0, "pos", "neg")
+    clf = lgb.LGBMClassifier(n_estimators=12, num_leaves=15, random_state=1)
+    clf.fit(X[:500], ylab[:500], eval_set=[(X[500:], ylab[500:])],
+            eval_metric="binary_logloss")
+    pred = clf.predict(X[500:])
+    proba = clf.predict_proba(X[500:])
+    assert set(pred) <= {"pos", "neg"}
+    assert (pred == ylab[500:]).mean() > 0.75
+    assert proba.shape == (100, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, rtol=1e-6)
+    assert list(clf.classes_) == ["neg", "pos"]
+    assert clf.n_classes_ == 2
+    assert clf.feature_importances_.shape == (6,)
+    assert "valid_0" in clf.evals_result_
+
+
+def test_regressor_and_clone():
+    from sklearn.base import clone
+    X, y = make_synthetic_regression(n=600, f=6)
+    reg = lgb.LGBMRegressor(n_estimators=8, num_leaves=15)
+    reg.fit(X[:500], y[:500])
+    r2 = 1 - np.mean((reg.predict(X[500:]) - y[500:]) ** 2) / np.var(y[500:])
+    assert r2 > 0.5
+    reg2 = clone(reg)
+    assert reg2.get_params()["n_estimators"] == 8
+    with pytest.raises(lgb.LightGBMError):
+        reg2.predict(X)  # not fitted
+
+
+def test_multiclass_classifier():
+    rs = np.random.RandomState(5)
+    X = rs.randn(500, 6)
+    y = np.digitize(X @ rs.randn(6), [-1, 1])
+    clf = lgb.LGBMClassifier(n_estimators=6, num_leaves=7)
+    clf.fit(X, y)
+    assert clf.n_classes_ == 3
+    proba = clf.predict_proba(X[:50])
+    assert proba.shape == (50, 3)
+    acc = (clf.predict(X) == y).mean()
+    assert acc > 0.6
+
+
+def test_ranker_requires_group():
+    X, y = make_synthetic_binary(n=200, f=4)
+    rk = lgb.LGBMRanker(n_estimators=3, num_leaves=7)
+    with pytest.raises(ValueError):
+        rk.fit(X, y)
+    rk.fit(X, (y * 3).astype(int), group=[50, 50, 50, 50])
+    assert rk.predict(X).shape == (200,)
+
+
+def test_custom_objective_callable():
+    X, y = make_synthetic_regression(n=400, f=5)
+
+    def mse_obj(y_true, y_pred):
+        return (y_pred - y_true), np.ones_like(y_true)
+
+    reg = lgb.LGBMRegressor(n_estimators=8, num_leaves=15,
+                            objective=mse_obj)
+    reg.fit(X, y)
+    pred = reg.predict(X)
+    assert np.corrcoef(pred, y)[0, 1] > 0.8
