@@ -9,12 +9,20 @@ loopback TCP sockets.
 import os
 
 # Force CPU: the ambient environment may point JAX_PLATFORMS at a remote
-# TPU tunnel, which would run every test over per-op RTT.
+# TPU tunnel, which would run every test over per-op RTT. The tunnel's
+# sitecustomize re-registers its platform and overrides the jax_platforms
+# config at interpreter start, so an env var alone is not enough — the
+# config must be re-overridden after importing jax (backends are not
+# initialized yet at conftest-import time, so this takes effect).
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest
