@@ -14,7 +14,6 @@ Run on the tunneled TPU:  python benchmarks/hist_micro.py
 Env: HM_ROWS, HM_FEATURES, HM_BINS.
 """
 
-import functools
 import os
 import time
 
@@ -82,7 +81,11 @@ if __name__ == "__main__":
     @jax.jit
     def onehot(bins_T, g, h, w, block=32768):
         gh = jnp.stack([g * w, h * w, w], axis=-1)
-        nblk = N // block
+        pad = (-N) % block
+        if pad:
+            bins_T = jnp.pad(bins_T, ((0, 0), (0, pad)))
+            gh = jnp.pad(gh, ((0, pad), (0, 0)))
+        nblk = bins_T.shape[1] // block
         bins_blk = bins_T.reshape(F, nblk, block).transpose(1, 0, 2)
         gh_blk = gh.reshape(nblk, block, 3)
 
@@ -127,3 +130,6 @@ if __name__ == "__main__":
                   flush=True)
         except Exception as e:  # noqa: BLE001
             print(f"{name:16s} FAILED: {type(e).__name__}: {e}", flush=True)
+    if results:
+        best = min(results, key=results.get)
+        print(f"best: {best} ({results[best]*1e3:.2f} ms)", flush=True)

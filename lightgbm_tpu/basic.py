@@ -178,6 +178,117 @@ class Dataset:
         self._query_boundaries: Optional[np.ndarray] = None
         self.used_indices = None
 
+    # -- binary serialization (save_binary, dataset.h:692 /
+    # dataset_loader.cpp:417 LoadFromBinFile analog: the binned matrix +
+    # mappers + metadata round-trip so re-runs skip parsing and binning) --
+    _BIN_MAGIC = "lightgbm_tpu.dataset.v1"
+
+    def save_binary(self, filename) -> "Dataset":
+        self.construct()
+        import json
+        meta = {
+            "magic": self._BIN_MAGIC,
+            "mappers": [m.to_dict() for m in self.mappers],
+            "full_mappers": [m.to_dict() if m is not None else None
+                             for m in self._full_mappers],
+            "feature_names": self._feature_names,
+            "F_total": int(self._F_total),
+            "cat_idx": sorted(int(c) for c in self._cat_idx),
+        }
+        arrays = {
+            "bins": self._bins,
+            "used_features": self._used_features,
+            "meta_json": np.frombuffer(
+                json.dumps(meta).encode(), dtype=np.uint8),
+        }
+        if self.label is not None:
+            arrays["label"] = np.asarray(self.label, np.float64)
+        if self.weight is not None:
+            arrays["weight"] = np.asarray(self.weight, np.float64)
+        if self._query_boundaries is not None:
+            arrays["query_boundaries"] = self._query_boundaries
+        if self.init_score is not None:
+            arrays["init_score"] = np.asarray(self.init_score, np.float64)
+        with open(filename, "wb") as f:
+            np.savez(f, **arrays)
+        return self
+
+    @staticmethod
+    def _is_binary_file(path: str) -> bool:
+        """Probe for our npz container: zip magic + the meta_json member.
+        A text file that merely starts with 'PK' falls through to the
+        text parser."""
+        try:
+            with open(path, "rb") as f:
+                if f.read(4) != b"PK\x03\x04":
+                    return False
+            with np.load(path, allow_pickle=False) as z:
+                return "meta_json" in z.files
+        except (OSError, ValueError, KeyError):
+            return False
+
+    def _construct_from_binary(self, path: str) -> "Dataset":
+        import json
+        from .ops.binning import BinMapper
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(bytes(z["meta_json"]).decode())
+            if meta.get("magic") != self._BIN_MAGIC:
+                raise LightGBMError(f"{path} is not a lightgbm_tpu "
+                                    "binary dataset")
+            self._bins = z["bins"]
+            self._used_features = z["used_features"].astype(np.int32)
+            if "label" in z.files and self.label is None:
+                self.label = z["label"]
+            if "weight" in z.files and self.weight is None:
+                self.weight = z["weight"]
+            if "query_boundaries" in z.files:
+                self._query_boundaries = z["query_boundaries"]
+            if "init_score" in z.files and self.init_score is None:
+                self.init_score = z["init_score"]
+        self.mappers = [BinMapper.from_dict(d) for d in meta["mappers"]]
+        self._feature_names = meta["feature_names"]
+        self._F_total = meta["F_total"]
+        self._cat_idx = set(meta["cat_idx"])
+        self._full_mappers = [None if d is None else BinMapper.from_dict(d)
+                              for d in meta["full_mappers"]]
+        self._n = self._bins.shape[0]
+        self._F = len(self.mappers)
+
+        # a valid set loaded from binary must share the reference's bin
+        # mappers (LoadFromBinFile alignment checks, dataset_loader.cpp)
+        if self.reference is not None:
+            ref = self.reference.construct()
+            ref_dicts = [m.to_dict() for m in ref.mappers]
+            own_dicts = [m.to_dict() for m in self.mappers]
+            if ref_dicts != own_dicts:
+                raise LightGBMError(
+                    f"Binary dataset {path} was binned differently from "
+                    "its reference dataset; rebuild it from text against "
+                    "the same training data")
+
+        # metadata supplied by the caller wins over the stored copies and
+        # gets the same normalization/validation as the text path
+        if self.label is not None:
+            self.label = np.asarray(self.label, np.float64).ravel()
+            if len(self.label) != self._n:
+                raise LightGBMError(
+                    f"Length of label ({len(self.label)}) != number of "
+                    f"rows ({self._n})")
+        if self.weight is not None:
+            self.weight = np.asarray(self.weight, np.float64).ravel()
+        if self.group is not None:
+            g = np.asarray(self.group, np.int64).ravel()
+            self._query_boundaries = np.concatenate(
+                [[0], np.cumsum(g)]).astype(np.int64)
+            if self._query_boundaries[-1] != self._n:
+                raise LightGBMError("Sum of group sizes != number of rows")
+        if self.init_score is not None:
+            self.init_score = np.asarray(self.init_score, np.float64)
+        self._handle = True
+        if self.free_raw_data:
+            self.data = None
+        return self
+
     # -- construction ---------------------------------------------------
     def construct(self) -> "Dataset":
         if self._handle is not None:
@@ -190,6 +301,8 @@ class Dataset:
 
         cat_idx: List[int] = []
         feature_name = self.feature_name
+        if isinstance(data, (str, Path)) and self._is_binary_file(str(data)):
+            return self._construct_from_binary(str(data))
         if isinstance(data, (str, Path)):
             X, y, w, q = _load_text_file(str(data), cfg)
             if label is None:

@@ -1,0 +1,204 @@
+"""Command-line application.
+
+Re-design of the reference CLI (/root/reference/src/application/
+application.cpp:31-285, src/main.cpp): ``key=value`` arguments plus an
+optional ``config=<file>`` configuration file, dispatching the tasks
+train / predict / convert_model / refit / save_binary.
+
+Usage:
+    python -m lightgbm_tpu config=train.conf [key=value ...]
+    python -m lightgbm_tpu task=train data=train.csv objective=binary
+
+Config-file syntax matches the reference (application.cpp:50-86 +
+config.cpp KV2Map): one ``key = value`` per line, ``#`` comments;
+command-line pairs override file pairs.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset, LightGBMError
+from .config import Config, resolve_params
+from .engine import train as train_fn
+from .utils.log import log_info, log_warning
+
+__all__ = ["main", "parse_args", "load_config_file"]
+
+
+def load_config_file(path: str) -> Dict[str, str]:
+    """Parse a ``key = value`` config file (Config::KV2Map semantics:
+    '#' starts a comment, keys/values are stripped)."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            if "=" not in line:
+                log_warning(f"Unknown config line ignored: {line!r}")
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    """CLI pairs override config-file pairs (application.cpp:50-86)."""
+    cli: Dict[str, str] = {}
+    for a in argv:
+        if "=" not in a:
+            raise LightGBMError(f"Unknown argument (expected key=value): {a}")
+        k, v = a.split("=", 1)
+        cli[k.strip()] = v.strip()
+    resolved = resolve_params(cli)
+    conf_path = resolved.pop("config", None)
+    params: Dict[str, str] = {}
+    if conf_path:
+        params.update(resolve_params(load_config_file(conf_path)))
+    params.update(resolved)
+    return params
+
+
+def _load_dataset(cfg: Config, params: Dict[str, Any], path: str,
+                  reference: Optional[Dataset] = None) -> Dataset:
+    ds = Dataset(path, params=params, reference=reference)
+    ds.construct()
+    return ds
+
+
+def _task_train(cfg: Config, params: Dict[str, Any]) -> None:
+    if not cfg.data:
+        raise LightGBMError("No training data: pass data=<file>")
+    train_set = _load_dataset(cfg, params, cfg.data)
+    valid_sets = [_load_dataset(cfg, params, v, reference=train_set)
+                  for v in cfg.valid]
+    valid_names = [f"valid_{i + 1}" for i in range(len(valid_sets))]
+
+    callbacks: List[Any] = []
+    if cfg.verbosity >= 1 and (valid_sets or cfg.is_provide_training_metric):
+        callbacks.append(callback_mod.log_evaluation(
+            period=max(1, cfg.metric_freq)))
+    if cfg.snapshot_freq > 0:
+        # periodic model snapshots (GBDT::Train, gbdt.cpp:250-254)
+        out = cfg.output_model
+
+        def _snapshot(env) -> None:
+            it = env.iteration + 1
+            if it % cfg.snapshot_freq == 0:
+                env.model.save_model(f"{out}.snapshot_iter_{it}")
+
+        _snapshot.order = 100
+        callbacks.append(_snapshot)
+    if cfg.is_provide_training_metric:
+        valid_sets = [train_set] + valid_sets
+        valid_names = ["training"] + valid_names
+
+    booster = train_fn(
+        params, train_set,
+        num_boost_round=cfg.num_iterations,
+        valid_sets=valid_sets, valid_names=valid_names,
+        init_model=cfg.input_model or None,
+        callbacks=callbacks)
+    booster.save_model(cfg.output_model)
+    log_info(f"Finished training; model saved to {cfg.output_model}")
+
+
+def _task_predict(cfg: Config, params: Dict[str, Any]) -> None:
+    if not cfg.input_model:
+        raise LightGBMError("task=predict needs input_model=<model file>")
+    if not cfg.data:
+        raise LightGBMError("No data to predict: pass data=<file>")
+    booster = Booster(model_file=cfg.input_model)
+    from .basic import _load_text_file
+    X, _, _, _ = _load_text_file(cfg.data, cfg)
+    num_iteration = (cfg.num_iteration_predict
+                     if cfg.num_iteration_predict > 0 else None)
+    pred = booster.predict(
+        X,
+        start_iteration=cfg.start_iteration_predict,
+        num_iteration=num_iteration,
+        raw_score=cfg.predict_raw_score,
+        pred_leaf=cfg.predict_leaf_index,
+        pred_contrib=cfg.predict_contrib)
+    pred = np.asarray(pred)
+    if pred.ndim == 1:
+        pred = pred[:, None]
+    fmt = "%d" if cfg.predict_leaf_index else "%.18g"
+    np.savetxt(cfg.output_result, pred, fmt=fmt, delimiter="\t")
+    log_info(f"Finished prediction; results saved to {cfg.output_result}")
+
+
+def _task_convert_model(cfg: Config, params: Dict[str, Any]) -> None:
+    if not cfg.input_model:
+        raise LightGBMError("task=convert_model needs input_model=<file>")
+    if cfg.convert_model_language not in ("", "cpp"):
+        raise LightGBMError(
+            f"Unsupported convert_model_language: "
+            f"{cfg.convert_model_language}")
+    booster = Booster(model_file=cfg.input_model)
+    from .convert import model_to_if_else
+    code = model_to_if_else(booster)
+    with open(cfg.convert_model, "w") as f:
+        f.write(code)
+    log_info(f"Converted model saved to {cfg.convert_model}")
+
+
+def _task_refit(cfg: Config, params: Dict[str, Any]) -> None:
+    if not cfg.input_model:
+        raise LightGBMError("task=refit needs input_model=<model file>")
+    if not cfg.data:
+        raise LightGBMError("No refit data: pass data=<file>")
+    booster = Booster(model_file=cfg.input_model)
+    from .basic import _load_text_file
+    X, y, w, _ = _load_text_file(cfg.data, cfg)
+    refitted = booster.refit(X, y, decay_rate=cfg.refit_decay_rate, weight=w)
+    refitted.save_model(cfg.output_model)
+    log_info(f"Finished refit; model saved to {cfg.output_model}")
+
+
+def _task_save_binary(cfg: Config, params: Dict[str, Any]) -> None:
+    if not cfg.data:
+        raise LightGBMError("No data: pass data=<file>")
+    ds = _load_dataset(cfg, params, cfg.data)
+    out = cfg.data + ".bin"
+    ds.save_binary(out)
+    log_info(f"Binned dataset saved to {out}")
+
+
+_TASKS = {
+    "train": _task_train,
+    "refit": _task_refit,
+    "refit_tree": _task_refit,
+    "predict": _task_predict,
+    "prediction": _task_predict,
+    "test": _task_predict,
+    "convert_model": _task_convert_model,
+    "save_binary": _task_save_binary,
+}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv in (["-h"], ["--help"]):
+        print(__doc__)
+        return 0
+    try:
+        params = parse_args(argv)
+        cfg = Config.from_params(params)
+        task = _TASKS.get(cfg.task)
+        if task is None:
+            raise LightGBMError(f"Unknown task: {cfg.task}")
+        task(cfg, params)
+    except LightGBMError as e:
+        print(f"[LightGBM-TPU] [Fatal] {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""CLI application tests (the reference's examples/*/train.conf pattern,
+tests/python_package_test/test_consistency.py)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import make_synthetic_binary
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.cli import main, parse_args, load_config_file
+
+
+@pytest.fixture(scope="module")
+def train_csv(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    X, y = make_synthetic_binary(n=500, f=5)
+    arr = np.column_stack([y, X])
+    path = d / "train.csv"
+    np.savetxt(path, arr, delimiter=",", fmt="%.8g")
+    return str(path), X, y
+
+
+def test_config_file_parsing(tmp_path):
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        "# comment line\n"
+        "task = train\n"
+        "objective=binary  # trailing comment\n"
+        "num_trees = 7\n"
+        "\n")
+    kv = load_config_file(str(conf))
+    assert kv == {"task": "train", "objective": "binary", "num_trees": "7"}
+    params = parse_args([f"config={conf}", "num_iterations=9"])
+    # CLI pair wins over config-file pair, alias resolved
+    assert params["num_iterations"] == "9"
+    assert params["objective"] == "binary"
+
+
+def test_cli_train_predict_roundtrip(train_csv, tmp_path):
+    path, X, y = train_csv
+    model_out = str(tmp_path / "model.txt")
+    rc = main([
+        "task=train", f"data={path}", "objective=binary",
+        "num_iterations=8", "num_leaves=7", "min_data_in_leaf=5",
+        f"output_model={model_out}", "verbosity=-1",
+    ])
+    assert rc == 0
+    assert os.path.exists(model_out)
+
+    pred_out = str(tmp_path / "preds.txt")
+    rc = main([
+        "task=predict", f"data={path}", f"input_model={model_out}",
+        f"output_result={pred_out}", "verbosity=-1",
+    ])
+    assert rc == 0
+    preds = np.loadtxt(pred_out)
+    assert preds.shape[0] == len(y)
+    acc = ((preds > 0.5) == y).mean()
+    assert acc > 0.8
+
+
+def test_cli_snapshot_and_continue(train_csv, tmp_path):
+    path, X, y = train_csv
+    model_out = str(tmp_path / "model.txt")
+    rc = main([
+        "task=train", f"data={path}", "objective=binary",
+        "num_iterations=4", "num_leaves=7", "min_data_in_leaf=5",
+        "snapshot_freq=2", f"output_model={model_out}", "verbosity=-1",
+    ])
+    assert rc == 0
+    assert os.path.exists(model_out + ".snapshot_iter_2")
+    # continued training from the saved model
+    model2 = str(tmp_path / "model2.txt")
+    rc = main([
+        "task=train", f"data={path}", "objective=binary",
+        "num_iterations=2", "num_leaves=7", "min_data_in_leaf=5",
+        f"input_model={model_out}", f"output_model={model2}",
+        "verbosity=-1",
+    ])
+    assert rc == 0
+    bst = lgb.Booster(model_file=model2)
+    assert bst.num_trees() == 6
+
+
+def test_cli_convert_model_compiles_and_matches(train_csv, tmp_path):
+    path, X, y = train_csv
+    model_out = str(tmp_path / "model.txt")
+    main(["task=train", f"data={path}", "objective=binary",
+          "num_iterations=5", "num_leaves=7", "min_data_in_leaf=5",
+          f"output_model={model_out}", "verbosity=-1"])
+    cpp_out = str(tmp_path / "model.cpp")
+    rc = main(["task=convert_model", f"input_model={model_out}",
+               f"convert_model={cpp_out}", "verbosity=-1"])
+    assert rc == 0
+    src = open(cpp_out).read()
+    assert "PredictTree0" in src and "void Predict(" in src
+
+    # compile + run the generated code against the python predictions
+    import shutil
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    harness = tmp_path / "harness.cpp"
+    harness.write_text(
+        '#include <cstdio>\n#include "model.cpp"\n'
+        "int main(){double fval[%d]; double out[1];\n"
+        "  while (scanf(\"%%lf %%lf %%lf %%lf %%lf\", &fval[0],&fval[1],"
+        "&fval[2],&fval[3],&fval[4])==5){\n"
+        "    lightgbm_tpu_model::Predict(fval,out);"
+        "printf(\"%%.10f\\n\",out[0]);}\n  return 0;}\n" % X.shape[1])
+    exe = str(tmp_path / "model_exe")
+    subprocess.run(["g++", "-O1", "-o", exe, str(harness)],
+                   check=True, cwd=tmp_path)
+    inp = "\n".join(" ".join(f"{v:.10g}" for v in row) for row in X[:50])
+    res = subprocess.run([exe], input=inp, capture_output=True, text=True,
+                         check=True)
+    cpp_preds = np.array([float(s) for s in res.stdout.split()])
+    bst = lgb.Booster(model_file=model_out)
+    py_preds = bst.predict(X[:50])
+    np.testing.assert_allclose(cpp_preds, py_preds, rtol=1e-6, atol=1e-6)
+
+
+def test_cli_refit(train_csv, tmp_path):
+    path, X, y = train_csv
+    model_out = str(tmp_path / "model.txt")
+    main(["task=train", f"data={path}", "objective=binary",
+          "num_iterations=5", "num_leaves=7", "min_data_in_leaf=5",
+          f"output_model={model_out}", "verbosity=-1"])
+    refit_out = str(tmp_path / "refit.txt")
+    rc = main(["task=refit", f"data={path}", f"input_model={model_out}",
+               f"output_model={refit_out}", "verbosity=-1"])
+    assert rc == 0
+    bst = lgb.Booster(model_file=refit_out)
+    assert bst.num_trees() == 5
+
+
+def test_save_binary_roundtrip(train_csv, tmp_path):
+    path, X, y = train_csv
+    rc = main(["task=save_binary", f"data={path}", "verbosity=-1"])
+    assert rc == 0
+    bin_path = path + ".bin"
+    assert os.path.exists(bin_path)
+
+    # binary load must give identical bins + metadata and train fine
+    ds_txt = lgb.Dataset(path).construct()
+    ds_bin = lgb.Dataset(bin_path).construct()
+    np.testing.assert_array_equal(ds_txt.host_bins(), ds_bin.host_bins())
+    np.testing.assert_array_equal(ds_txt.get_label(), ds_bin.get_label())
+    assert ds_txt.get_feature_name() == ds_bin.get_feature_name()
+
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbosity": -1},
+                    ds_bin, num_boost_round=5)
+    pred = bst.predict(X)
+    assert (((pred > 0.5) == y).mean()) > 0.8
+    os.remove(bin_path)
