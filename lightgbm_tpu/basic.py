@@ -522,6 +522,14 @@ class Dataset:
                 nb.append(-1)
         return jnp.asarray(nb, jnp.int32)
 
+    def device_feat_is_cat(self):
+        """[F] bool categorical-feature mask, or None if all numerical."""
+        import jax.numpy as jnp
+        self.construct()
+        arr = np.asarray([m.bin_type == BinType.CATEGORICAL
+                          for m in self.mappers], bool)
+        return jnp.asarray(arr) if arr.any() else None
+
     def used_feature_indices(self) -> np.ndarray:
         self.construct()
         return self._used_features

@@ -194,7 +194,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         if task is None:
             raise LightGBMError(f"Unknown task: {cfg.task}")
         task(cfg, params)
-    except LightGBMError as e:
+    except (LightGBMError, ValueError, OSError) as e:
         print(f"[LightGBM-TPU] [Fatal] {e}", file=sys.stderr)
         return 1
     return 0

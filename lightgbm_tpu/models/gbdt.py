@@ -39,12 +39,12 @@ __all__ = ["GBDTBooster"]
 @jax.jit
 def _tree_values_binned(split_feature, threshold_bin, default_left,
                         left_child, right_child, leaf_value,
-                        feat_nan_bin, bins_T):
+                        feat_nan_bin, bins_T, is_cat=None, cat_masks=None):
     """Jitted per-row tree output over binned data (compiled once per
     (num_leaves, n) shape — trees are padded to the configured size)."""
     leaves = predict_leaf_binned(split_feature, threshold_bin, default_left,
                                  left_child, right_child, feat_nan_bin,
-                                 bins_T)
+                                 bins_T, is_cat, cat_masks)
     return leaf_value[leaves]
 
 
@@ -76,6 +76,7 @@ class GBDTBooster:
         self.bins_T = ds.device_bins()            # [F, n]
         self.feat_num_bins = ds.device_feat_num_bins()
         self.feat_nan_bin = ds.device_feat_nan_bin()
+        self.feat_is_cat = ds.device_feat_is_cat()
         self.label = jnp.asarray(ds.get_label(), jnp.float32)
         w = ds.get_weight()
         self.weight = None if w is None else jnp.asarray(w, jnp.float32)
@@ -133,6 +134,11 @@ class GBDTBooster:
                 min_data_in_leaf=float(cfg.min_data_in_leaf),
                 min_sum_hessian_in_leaf=cfg.min_sum_hessian_in_leaf,
                 min_gain_to_split=cfg.min_gain_to_split,
+                cat_smooth=cfg.cat_smooth,
+                cat_l2=cfg.cat_l2,
+                max_cat_threshold=cfg.max_cat_threshold,
+                max_cat_to_onehot=cfg.max_cat_to_onehot,
+                min_data_per_group=float(cfg.min_data_per_group),
             ),
         )
         # -- distributed setup: mesh instead of Network::Init ------------
@@ -154,7 +160,8 @@ class GBDTBooster:
                 self.bins_T = jnp.pad(self.bins_T,
                                       ((0, 0), (0, self._pad)))
             self._grow_fn = make_dp_grow_fn(
-                self.grow_cfg, self.mesh, self.monotone is not None)
+                self.grow_cfg, self.mesh, self.monotone is not None,
+                self.feat_is_cat is not None)
 
         seed = cfg.seed if cfg.seed is not None else 0
         self._base_key = jax.random.PRNGKey(seed)
@@ -203,23 +210,38 @@ class GBDTBooster:
             score = score / self.iter_
         return score
 
-    def _binned_thresholds(self, tree: Tree) -> np.ndarray:
-        """Re-derive bin-space thresholds for a tree loaded from a model
-        file (threshold_bin is only carried in memory). Numerical nodes
-        map the real threshold onto the current binning; categorical nodes
-        reconstruct the left-set bin prefix from the bitset."""
+    def _binned_node_arrays(self, tree: Tree):
+        """Per-node (threshold_bin, is_cat, cat_bin_mask) in the train
+        set's bin space. Numerical nodes loaded from a model file map the
+        real threshold onto the current binning; categorical nodes
+        reconstruct exact bin membership from the category bitset
+        (the inverse of tree_from_arrays' bitset emission). Cached on the
+        tree — node structure is immutable after growth."""
+        cached = getattr(tree, "_binned_cache", None)
+        if cached is not None and cached[0] is self.train_set:
+            return cached[1]
         inner = self.train_set.inner_feature_index(tree.split_feature)
-        tb = np.zeros(tree.num_nodes, np.int32)
-        for i in range(tree.num_nodes):
+        nn = tree.num_nodes
+        B = int(self.grow_cfg.num_bins)
+        tb = np.zeros(nn, np.int32)
+        isc = np.zeros(nn, bool)
+        cmask = np.zeros((nn, B), bool)
+        for i in range(nn):
             m = self.train_set.mappers[inner[i]]
             if tree.is_categorical_node(i):
-                member = [b for b in range(len(m.bin_to_cat))
-                          if tree._cat_decision(i, float(m.bin_to_cat[b]))]
-                tb[i] = max(member) if member else -1
+                isc[i] = True
+                nb = min(len(m.bin_to_cat), B)
+                for b in range(nb):
+                    cmask[i, b] = tree._cat_decision(
+                        i, float(m.bin_to_cat[b]))
+            elif tree.threshold_bin[i] >= 0:
+                tb[i] = tree.threshold_bin[i]
             else:
                 tb[i] = int(np.searchsorted(m.upper_bounds,
                                             tree.threshold[i], side="left"))
-        return tb
+        out = (tb, isc, cmask)
+        tree._binned_cache = (self.train_set, out)
+        return out
 
     def _predict_tree_binned_host(self, tree: Tree,
                                   bins_T: jnp.ndarray) -> jnp.ndarray:
@@ -228,9 +250,7 @@ class GBDTBooster:
                             jnp.float32)
         # map real feature index back to inner (used-feature) index
         inner = self.train_set.inner_feature_index(tree.split_feature)
-        tb = tree.threshold_bin
-        if (tb < 0).any():
-            tb = self._binned_thresholds(tree)
+        tb, isc, cmask = self._binned_node_arrays(tree)
         # pad to the configured num_leaves so the jitted traversal
         # compiles once per dataset, not once per tree
         L = max(self.cfg.num_leaves, tree.num_leaves)
@@ -241,6 +261,14 @@ class GBDTBooster:
             out[: len(a)] = a
             return out
 
+        if self.feat_is_cat is not None:
+            B = cmask.shape[1]
+            cm_pad = np.zeros((nn, B), bool)
+            cm_pad[: len(cmask)] = cmask
+            cat_args = (jnp.asarray(pad(isc, nn, False, bool)),
+                        jnp.asarray(cm_pad))
+        else:
+            cat_args = (None, None)
         return _tree_values_binned(
             jnp.asarray(pad(inner, nn, 0, np.int32)),
             jnp.asarray(pad(tb, nn, 0, np.int32)),
@@ -248,7 +276,7 @@ class GBDTBooster:
             jnp.asarray(pad(tree.left_child, nn, -1, np.int32)),
             jnp.asarray(pad(tree.right_child, nn, -1, np.int32)),
             jnp.asarray(pad(tree.leaf_value, L, 0.0, np.float32)),
-            self.feat_nan_bin, bins_T)
+            self.feat_nan_bin, bins_T, *cat_args)
 
     # ------------------------------------------------------------------
     # sampling strategies (bagging.hpp / goss.hpp analogs)
@@ -365,13 +393,15 @@ class GBDTBooster:
                         self.feat_num_bins, self.feat_nan_bin)
                 if self.monotone is not None:
                     args = args + (self.monotone,)
+                if self.feat_is_cat is not None:
+                    args = args + (self.feat_is_cat,)
                 dev_tree, row_leaf = self._grow_fn(*args)
                 row_leaf = row_leaf[: self.n]
             else:
                 dev_tree, row_leaf = grow_tree(
                     self.grow_cfg, self.bins_T, grad[k], hess[k], row_w,
                     fmask, self.feat_num_bins, self.feat_nan_bin,
-                    self.monotone)
+                    self.monotone, self.feat_is_cat)
             num_leaves = int(np.asarray(dev_tree.num_leaves))
             if num_leaves <= 1:
                 # constant tree; carries the boost_from_average bias when

@@ -203,6 +203,8 @@ def tree_from_arrays(dev_tree, mappers: Sequence[BinMapper],
         sf = inner_sf
     tb = np.asarray(dev_tree.threshold_bin)[:nn].astype(np.int32)
     dl = np.asarray(dev_tree.default_left)[:nn]
+    is_cat_node = np.asarray(dev_tree.split_is_cat)[:nn]
+    cat_masks = np.asarray(dev_tree.split_cat_mask)[:nn]
     thr = np.zeros(nn, np.float64)
     dtypes = np.zeros(nn, np.uint8)
     cat_boundaries = [0]
@@ -213,11 +215,14 @@ def tree_from_arrays(dev_tree, mappers: Sequence[BinMapper],
         m = mappers[inner_sf[i]]
         code = _MISSING_CODE[m.missing_type] << 2
         if m.bin_type == BinType.CATEGORICAL:
-            # The grower split "bin <= t -> left" over frequency-ordered
-            # category bins; realize it as a bitset over the raw category
-            # values of bins [0, t] (tree.h SplitCategorical layout:
-            # threshold = index into cat_boundaries_).
-            cats = np.asarray(m.bin_to_cat[: int(tb[i]) + 1], np.int64)
+            # Realize the bin-membership mask from the split search as a
+            # bitset over raw category values (tree.h SplitCategorical
+            # layout: threshold = index into cat_boundaries_).
+            if is_cat_node[i]:
+                member = np.where(cat_masks[i][: len(m.bin_to_cat)])[0]
+            else:  # legacy prefix split "bin <= t"
+                member = np.arange(min(int(tb[i]) + 1, len(m.bin_to_cat)))
+            cats = np.asarray(m.bin_to_cat, np.int64)[member]
             nwords = (int(cats.max()) // 32 + 1) if len(cats) else 1
             words = np.zeros(nwords, np.uint32)
             for c in cats:

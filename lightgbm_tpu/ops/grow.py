@@ -74,6 +74,8 @@ class TreeArrays(NamedTuple):
     leaf_parent: jnp.ndarray     # [L] i32
     leaf_depth: jnp.ndarray      # [L] i32
     num_leaves: jnp.ndarray      # scalar i32 (actual leaves grown)
+    split_is_cat: jnp.ndarray    # [L-1] bool — categorical membership split
+    split_cat_mask: jnp.ndarray  # [L-1, B] bool — bins routed left
 
 
 class _BestSplits(NamedTuple):
@@ -82,6 +84,8 @@ class _BestSplits(NamedTuple):
     feature: jnp.ndarray
     threshold_bin: jnp.ndarray
     default_left: jnp.ndarray
+    is_cat: jnp.ndarray        # [L] bool
+    cat_mask: jnp.ndarray      # [L, B] bool
     left_sum_g: jnp.ndarray
     left_sum_h: jnp.ndarray
     left_count: jnp.ndarray
@@ -92,13 +96,15 @@ class _BestSplits(NamedTuple):
     right_output: jnp.ndarray
 
     @staticmethod
-    def init(L: int, dtype) -> "_BestSplits":
+    def init(L: int, B: int, dtype) -> "_BestSplits":
         zf = jnp.zeros((L,), dtype=dtype)
         return _BestSplits(
             gain=jnp.full((L,), NEG_INF, dtype=dtype),
             feature=jnp.zeros((L,), jnp.int32),
             threshold_bin=jnp.zeros((L,), jnp.int32),
             default_left=jnp.zeros((L,), jnp.bool_),
+            is_cat=jnp.zeros((L,), jnp.bool_),
+            cat_mask=jnp.zeros((L, B), jnp.bool_),
             left_sum_g=zf, left_sum_h=zf, left_count=zf,
             right_sum_g=zf, right_sum_h=zf, right_count=zf,
             left_output=zf, right_output=zf,
@@ -111,6 +117,8 @@ class _BestSplits(NamedTuple):
             feature=self.feature.at[i].set(r.feature),
             threshold_bin=self.threshold_bin.at[i].set(r.threshold_bin),
             default_left=self.default_left.at[i].set(r.default_left),
+            is_cat=self.is_cat.at[i].set(r.is_cat),
+            cat_mask=self.cat_mask.at[i].set(r.cat_mask),
             left_sum_g=self.left_sum_g.at[i].set(r.left_sum_g),
             left_sum_h=self.left_sum_h.at[i].set(r.left_sum_h),
             left_count=self.left_count.at[i].set(r.left_count),
@@ -130,8 +138,10 @@ class _GrowState(NamedTuple):
     num_splits: jnp.ndarray  # scalar i32
 
 
-def _init_tree(L: int, dtype) -> TreeArrays:
+def _init_tree(L: int, B: int, dtype) -> TreeArrays:
     return TreeArrays(
+        split_is_cat=jnp.zeros((L - 1,), jnp.bool_),
+        split_cat_mask=jnp.zeros((L - 1, B), jnp.bool_),
         split_feature=jnp.zeros((L - 1,), jnp.int32),
         threshold_bin=jnp.zeros((L - 1,), jnp.int32),
         default_left=jnp.zeros((L - 1,), jnp.bool_),
@@ -158,7 +168,8 @@ def grow_tree_impl(cfg: GrowConfig,
                    feature_mask: jnp.ndarray,
                    feat_num_bins: jnp.ndarray,
                    feat_nan_bin: jnp.ndarray,
-                   monotone_constraints: Optional[jnp.ndarray] = None):
+                   monotone_constraints: Optional[jnp.ndarray] = None,
+                   feat_is_cat: Optional[jnp.ndarray] = None):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf).
 
     Args:
@@ -180,7 +191,8 @@ def grow_tree_impl(cfg: GrowConfig,
 
     def best_for(hist, sg, sh, sc):
         return find_best_split(hist, sg, sh, sc, feat_num_bins, feat_nan_bin,
-                               feature_mask, p, monotone_constraints)
+                               feature_mask, p, monotone_constraints,
+                               feat_is_cat)
 
     # ---- root (GlobalSyncUpBySum analog for the root tuple) ----
     w = row_weight.astype(dtype)
@@ -191,13 +203,13 @@ def grow_tree_impl(cfg: GrowConfig,
     root_hist = psum(build_histogram(bins_T, grad, hess, row_weight,
                                      all_rows, B, cfg.hist_method))
 
-    tree = _init_tree(L, dtype)
+    tree = _init_tree(L, B, dtype)
     tree = tree._replace(
         leaf_value=tree.leaf_value.at[0].set(leaf_output(total_g, total_h, p)),
         leaf_weight=tree.leaf_weight.at[0].set(total_h),
         leaf_count=tree.leaf_count.at[0].set(total_c),
     )
-    best = _BestSplits.init(L, dtype)
+    best = _BestSplits.init(L, B, dtype)
     best = best.store(0, best_for(root_hist, total_g, total_h, total_c),
                       jnp.asarray(True))
     hists = jnp.zeros((L, F, B, 3), dtype).at[0].set(root_hist)
@@ -222,7 +234,10 @@ def grow_tree_impl(cfg: GrowConfig,
         col = lax.dynamic_index_in_dim(bins_T, f, axis=0,
                                        keepdims=False).astype(jnp.int32)
         nan_bin = feat_nan_bin[f]
-        go_left = jnp.where((nan_bin >= 0) & (col == nan_bin), dl, col <= t)
+        go_left_num = jnp.where((nan_bin >= 0) & (col == nan_bin), dl,
+                                col <= t)
+        cm = best.cat_mask[leaf]
+        go_left = jnp.where(best.is_cat[leaf], cm[col], go_left_num)
         on_leaf = row_leaf == leaf
         row_leaf = jnp.where(on_leaf & ~go_left, R, row_leaf)
 
@@ -245,6 +260,8 @@ def grow_tree_impl(cfg: GrowConfig,
             split_feature=tree.split_feature.at[ns].set(f),
             threshold_bin=tree.threshold_bin.at[ns].set(t),
             default_left=tree.default_left.at[ns].set(dl),
+            split_is_cat=tree.split_is_cat.at[ns].set(best.is_cat[leaf]),
+            split_cat_mask=tree.split_cat_mask.at[ns].set(cm),
             left_child=lc,
             right_child=rc,
             split_gain=tree.split_gain.at[ns].set(best.gain[leaf]),

@@ -66,11 +66,13 @@ def _traverse(n: int, decide_fn, left_child, right_child):
 
 def predict_leaf_binned(split_feature, threshold_bin, default_left,
                         left_child, right_child, feat_nan_bin,
-                        bins_T) -> jnp.ndarray:
+                        bins_T, is_cat=None, cat_masks=None) -> jnp.ndarray:
     """Leaf index per row for one tree over the *binned* matrix [F, n].
 
     Used for train/valid score updates during boosting, where data is
     already binned (the ScoreUpdater::AddScore analog, score_updater.hpp).
+    ``is_cat``/``cat_masks`` ([nn] bool, [nn, B] bool) route categorical
+    nodes by bin membership instead of the bin threshold.
     """
     n = bins_T.shape[1]
     rows = jnp.arange(n)
@@ -79,8 +81,11 @@ def predict_leaf_binned(split_feature, threshold_bin, default_left,
         sf = split_feature[idx]
         v = bins_T[sf, rows].astype(jnp.int32)
         nb = feat_nan_bin[sf]
-        return jnp.where((nb >= 0) & (v == nb), default_left[idx],
-                         v <= threshold_bin[idx])
+        num_left = jnp.where((nb >= 0) & (v == nb), default_left[idx],
+                             v <= threshold_bin[idx])
+        if is_cat is None:
+            return num_left
+        return jnp.where(is_cat[idx], cat_masks[idx, v], num_left)
 
     return _traverse(n, decide, left_child, right_child)
 

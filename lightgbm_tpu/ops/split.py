@@ -32,6 +32,12 @@ class SplitParams(NamedTuple):
     min_data_in_leaf: float = 20.0
     min_sum_hessian_in_leaf: float = 1e-3
     min_gain_to_split: float = 0.0
+    # categorical-split knobs (feature_histogram.hpp categorical path)
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
+    min_data_per_group: float = 100.0
 
 
 class SplitResult(NamedTuple):
@@ -40,6 +46,8 @@ class SplitResult(NamedTuple):
     feature: jnp.ndarray       # i32
     threshold_bin: jnp.ndarray  # i32
     default_left: jnp.ndarray  # bool
+    is_cat: jnp.ndarray        # bool — categorical membership split
+    cat_mask: jnp.ndarray      # [B] bool — bins routed left (cat splits)
     left_sum_g: jnp.ndarray
     left_sum_h: jnp.ndarray
     left_count: jnp.ndarray
@@ -75,6 +83,88 @@ def leaf_gain(sum_g, sum_h, p: SplitParams):
     return t * t / (sum_h + p.lambda_l2 + K_EPS)
 
 
+def _cat_split_eval(hist, parent_g, parent_h, parent_cnt,
+                    feat_num_bins, p: SplitParams):
+    """Categorical split candidates, vectorized over all features.
+
+    Mirrors FindBestThresholdCategoricalInner
+    (src/treelearner/feature_histogram.cpp:144):
+    - features with <= max_cat_to_onehot bins: one-hot scan — each bin as
+      a left-singleton, plain lambda_l2;
+    - otherwise: bins with enough data sorted ascending by
+      g / (h + cat_smooth); prefix scans from both ends, left-set size
+      capped at min(max_cat_threshold, (used+1)//2), l2 += cat_l2.
+    Deviation from the reference: the sequential ``cnt_cur_group``
+    min_data_per_group regrouping is relaxed to the (necessary) condition
+    ``left_count >= min_data_per_group`` — the reference's rule is a
+    path-dependent scan that would serialize the TPU program; the
+    relaxation admits a superset of candidate prefixes.
+
+    Returns (gains_oh, gains_fwd, gains_bwd, csum_f, csum_b, aux) where
+    gains_* are [F, B] (position-indexed for fwd/bwd) and aux carries the
+    sort order data needed to reconstruct the winning bin set.
+    """
+    F, B, _ = hist.shape
+    dtype = hist.dtype
+    bins = jnp.arange(B)
+    in_range = bins[None, :] < feat_num_bins[:, None]
+    h3 = jnp.where(in_range[:, :, None], hist, jnp.zeros_like(hist))
+    g, h, c = h3[..., 0], h3[..., 1], h3[..., 2]
+    p_cat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
+
+    # ---- one-hot path (left = one category bin) ----
+    rg, rh, rc = parent_g - g, parent_h - h, parent_cnt - c
+    valid_oh = (
+        in_range
+        & (c >= p.min_data_in_leaf) & (rc >= p.min_data_in_leaf)
+        & (h >= p.min_sum_hessian_in_leaf)
+        & (rh >= p.min_sum_hessian_in_leaf)
+        & (c > 0) & (rc > 0)
+    )
+    gain_oh = leaf_gain(g, h, p) + leaf_gain(rg, rh, p)
+    use_onehot = feat_num_bins <= p.max_cat_to_onehot  # [F]
+    gains_oh = jnp.where(use_onehot[:, None] & valid_oh, gain_oh,
+                         K_MIN_SCORE)
+
+    # ---- sorted-subset path ----
+    participate = in_range & (c >= p.cat_smooth)
+    ratio = jnp.where(participate, g / (h + p.cat_smooth), jnp.inf)
+    order = jnp.argsort(ratio, axis=1, stable=True)          # [F, B]
+    inv = jnp.argsort(order, axis=1, stable=True)            # bin -> rank
+    used = jnp.sum(participate, axis=1).astype(jnp.int32)    # [F]
+    part_sorted = jnp.take_along_axis(participate, order, axis=1)
+    stats_sorted = jnp.take_along_axis(h3, order[:, :, None], axis=1) \
+        * part_sorted[:, :, None].astype(dtype)
+    csum_f = jnp.cumsum(stats_sorted, axis=1)                # [F, B, 3]
+    rev_pos = jnp.clip(used[:, None] - 1 - bins[None, :], 0, B - 1)
+    stats_rev = jnp.take_along_axis(stats_sorted, rev_pos[:, :, None],
+                                    axis=1)
+    csum_b = jnp.cumsum(stats_rev, axis=1)
+
+    max_num_cat = jnp.minimum(p.max_cat_threshold, (used + 1) // 2)
+    pos_ok = (bins[None, :] < max_num_cat[:, None]) \
+        & (bins[None, :] < used[:, None])
+    right_min = max(p.min_data_in_leaf, p.min_data_per_group)
+
+    def prefix_gains(csum):
+        lg, lh, lc = csum[..., 0], csum[..., 1], csum[..., 2]
+        rg_, rh_, rc_ = parent_g - lg, parent_h - lh, parent_cnt - lc
+        valid = (
+            pos_ok
+            & (lc >= p.min_data_in_leaf) & (lc >= p.min_data_per_group)
+            & (lh >= p.min_sum_hessian_in_leaf)
+            & (rc_ >= right_min) & (rh_ >= p.min_sum_hessian_in_leaf)
+            & (lc > 0) & (rc_ > 0)
+        )
+        gain = leaf_gain(lg, lh, p_cat) + leaf_gain(rg_, rh_, p_cat)
+        return jnp.where(valid & ~use_onehot[:, None], gain, K_MIN_SCORE)
+
+    gains_fwd = prefix_gains(csum_f)
+    gains_bwd = prefix_gains(csum_b)
+    aux = (inv, used, participate)
+    return gains_oh, gains_fwd, gains_bwd, csum_f, csum_b, aux
+
+
 def find_best_split(hist: jnp.ndarray,
                     parent_g: jnp.ndarray,
                     parent_h: jnp.ndarray,
@@ -83,7 +173,8 @@ def find_best_split(hist: jnp.ndarray,
                     feat_nan_bin: jnp.ndarray,
                     feature_mask: jnp.ndarray,
                     p: SplitParams,
-                    monotone_constraints: jnp.ndarray | None = None
+                    monotone_constraints: jnp.ndarray | None = None,
+                    feat_is_cat: jnp.ndarray | None = None
                     ) -> SplitResult:
     """Find the best (feature, threshold) over a leaf's histograms.
 
@@ -153,19 +244,56 @@ def find_best_split(hist: jnp.ndarray,
     gains_r = jnp.where(fmask, gains_r, K_MIN_SCORE)
     gains_l = jnp.where(fmask, gains_l, K_MIN_SCORE)
 
+    if feat_is_cat is not None:
+        num_ok = ~feat_is_cat[:, None]
+        gains_r = jnp.where(num_ok, gains_r, K_MIN_SCORE)
+        gains_l = jnp.where(num_ok, gains_l, K_MIN_SCORE)
+        g_oh, g_fwd, g_bwd, csum_f, csum_b, (inv, used, participate) = \
+            _cat_split_eval(hist, total[0], total[1], total[2],
+                            feat_num_bins, p)
+        cmask = fmask & feat_is_cat[:, None]
+        g_oh = jnp.where(cmask, g_oh, K_MIN_SCORE)
+        g_fwd = jnp.where(cmask, g_fwd, K_MIN_SCORE)
+        g_bwd = jnp.where(cmask, g_bwd, K_MIN_SCORE)
+        stacks = [gains_r, gains_l, g_oh, g_fwd, g_bwd]
+    else:
+        stacks = [gains_r, gains_l]
+
     # argmax with deterministic tie-breaking: lower (dir, feature, bin) wins
-    all_gains = jnp.stack([gains_r, gains_l])  # [2, F, B]
+    all_gains = jnp.stack(stacks)  # [D, F, B]
     flat_idx = jnp.argmax(all_gains)
     best_gain_raw = all_gains.reshape(-1)[flat_idx]
     d = flat_idx // (F * B)
     f = (flat_idx // B) % F
     t = flat_idx % B
 
-    sel_left = jnp.where(
-        d == 0,
-        cum[f, t, :],
-        cum[f, t, :] + nan_stats[f, :],
-    )
+    if feat_is_cat is not None:
+        is_cat = d >= 2
+        is_sorted_cat = d >= 3
+        bins_b = jnp.arange(B)
+        onehot_mask = bins_b == t
+        fwd_mask = participate[f] & (inv[f] <= t)
+        bwd_mask = participate[f] & (inv[f] >= used[f] - 1 - t)
+        cat_mask = jnp.where(
+            is_cat,
+            jnp.where(d == 2, onehot_mask,
+                      jnp.where(d == 3, fwd_mask, bwd_mask)),
+            jnp.zeros((B,), jnp.bool_))
+        num_left = jnp.where(d == 0, cum[f, t, :],
+                             cum[f, t, :] + nan_stats[f, :])
+        cat_left = jnp.where(d == 2, hist[f, t, :],
+                             jnp.where(d == 3, csum_f[f, t, :],
+                                       csum_b[f, t, :]))
+        sel_left = jnp.where(is_cat, cat_left, num_left)
+    else:
+        is_cat = jnp.asarray(False)
+        is_sorted_cat = jnp.asarray(False)
+        cat_mask = jnp.zeros((B,), jnp.bool_)
+        sel_left = jnp.where(
+            d == 0,
+            cum[f, t, :],
+            cum[f, t, :] + nan_stats[f, :],
+        )
     lg, lh, lc = sel_left[0], sel_left[1], sel_left[2]
     rg, rh, rc = total[0] - lg, total[1] - lh, total[2] - lc
 
@@ -173,13 +301,23 @@ def find_best_split(hist: jnp.ndarray,
     gain = best_gain_raw - parent_gain - p.min_gain_to_split
     gain = jnp.where(jnp.isfinite(best_gain_raw), gain, K_MIN_SCORE)
 
+    # sorted categorical splits use l2 + cat_l2 for leaf outputs
+    # (feature_histogram.cpp:144 `l2 += cat_l2` before the output calc)
+    p_cat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
+    lo = jnp.where(is_sorted_cat, leaf_output(lg, lh, p_cat),
+                   leaf_output(lg, lh, p))
+    ro = jnp.where(is_sorted_cat, leaf_output(rg, rh, p_cat),
+                   leaf_output(rg, rh, p))
+
     return SplitResult(
         gain=gain.astype(dtype),
         feature=f.astype(jnp.int32),
         threshold_bin=t.astype(jnp.int32),
         default_left=(d == 1),
+        is_cat=is_cat,
+        cat_mask=cat_mask,
         left_sum_g=lg, left_sum_h=lh, left_count=lc,
         right_sum_g=rg, right_sum_h=rh, right_count=rc,
-        left_output=leaf_output(lg, lh, p),
-        right_output=leaf_output(rg, rh, p),
+        left_output=lo,
+        right_output=ro,
     )

@@ -45,18 +45,22 @@ __all__ = ["make_dp_grow_fn"]
 
 
 @functools.lru_cache(maxsize=32)
-def _build(cfg: GrowConfig, mesh: Mesh, has_monotone: bool):
+def _build(cfg: GrowConfig, mesh: Mesh, has_monotone: bool, has_cat: bool):
     axis = mesh.axis_names[0]
     cfg = cfg._replace(axis_name=axis)
     rowspec = P(axis)
     rep = P()
 
     in_specs = (P(None, axis), rowspec, rowspec, rowspec, rep, rep, rep)
-    in_specs = in_specs + ((rep,) if has_monotone else ())
+    in_specs = in_specs + (rep,) * (int(has_monotone) + int(has_cat))
     out_specs = (rep, rowspec)  # tree replicated, row_leaf sharded
 
-    def fn(*args):
-        return grow_tree_impl(cfg, *args)
+    def fn(bins_T, grad, hess, row_w, fmask, fnb, fnan, *rest):
+        rest = list(rest)
+        mono = rest.pop(0) if has_monotone else None
+        cat = rest.pop(0) if has_cat else None
+        return grow_tree_impl(cfg, bins_T, grad, hess, row_w, fmask,
+                              fnb, fnan, mono, cat)
 
     sharded = shard_map(fn, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_rep=False)
@@ -64,8 +68,9 @@ def _build(cfg: GrowConfig, mesh: Mesh, has_monotone: bool):
 
 
 def make_dp_grow_fn(cfg: GrowConfig, mesh: Mesh,
-                    has_monotone: bool = False):
-    """Returns grow(bins_T, grad, hess, row_w, fmask, fnb, fnan[, mono])
-    running data-parallel over ``mesh``. Row inputs must be padded to a
-    multiple of the device count (pad rows carry row_weight 0)."""
-    return _build(cfg, mesh, has_monotone)
+                    has_monotone: bool = False, has_cat: bool = False):
+    """Returns grow(bins_T, grad, hess, row_w, fmask, fnb, fnan[, mono]
+    [, feat_is_cat]) running data-parallel over ``mesh``. Row inputs must
+    be padded to a multiple of the device count (pad rows carry
+    row_weight 0)."""
+    return _build(cfg, mesh, has_monotone, has_cat)

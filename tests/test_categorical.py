@@ -1,0 +1,100 @@
+"""Categorical feature training (the reference's categorical split path:
+feature_histogram.cpp FindBestThresholdCategoricalInner, tree.h
+SplitCategorical; behavioral spec mirrored from
+tests/python_package_test/test_engine.py categorical tests)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _cat_data(n=3000, seed=0):
+    rs = np.random.RandomState(seed)
+    cat = rs.randint(0, 30, n).astype(np.float64)
+    num = rs.randn(n)
+    y = ((cat < 10).astype(float) * 2.0 + 0.3 * num
+         + 0.1 * rs.randn(n) > 1.0).astype(np.float64)
+    return np.column_stack([cat, num]), y
+
+
+def test_categorical_splits_learned():
+    X, y = _cat_data()
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "learning_rate": 0.2, "verbose": -1},
+                    ds, num_boost_round=20)
+    model = bst.model_to_string()
+    assert "num_cat=1" in model or "num_cat=2" in model
+    pred = bst.predict(X)
+    assert ((pred > 0.5) == y).mean() > 0.9
+
+
+def test_categorical_model_roundtrip():
+    X, y = _cat_data(seed=1)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1}, ds, num_boost_round=10)
+    s = bst.model_to_string()
+    bst2 = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(bst.predict(X), bst2.predict(X), rtol=1e-6)
+
+
+def test_categorical_onehot_path():
+    """Features with <= max_cat_to_onehot bins use the one-hot scan."""
+    rs = np.random.RandomState(2)
+    n = 2000
+    cat = rs.randint(0, 4, n).astype(np.float64)
+    y = (cat == 2).astype(np.float64)
+    ds = lgb.Dataset(cat.reshape(-1, 1), label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbose": -1},
+                    ds, num_boost_round=5)
+    pred = bst.predict(cat.reshape(-1, 1))
+    assert ((pred > 0.5) == y).mean() > 0.99
+    # one-hot: the winning left set is a single category
+    t0 = bst.dump_model()["tree_info"][0]["tree_structure"]
+    assert t0["decision_type"] == "=="
+
+
+def test_categorical_unseen_category_routes_right():
+    X, y = _cat_data(seed=3)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1}, ds, num_boost_round=10)
+    Xu = X.copy()
+    Xu[:5, 0] = 999  # category never seen in training
+    pred = bst.predict(Xu)
+    assert np.isfinite(pred).all()
+
+
+def test_categorical_valid_set_scoring_consistent():
+    """Binned valid-set scoring must match raw-feature prediction."""
+    X, y = _cat_data(seed=4)
+    Xv, yv = _cat_data(seed=5)
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+    dv = ds.create_valid(Xv, label=yv)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "metric": "binary_logloss", "verbose": -1},
+                    ds, num_boost_round=10, valid_sets=[dv],
+                    valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    from lightgbm_tpu.metrics import create_metrics
+    pred = bst.predict(Xv)
+    eps = 1e-15
+    p = np.clip(pred, eps, 1 - eps)
+    ll = -np.mean(yv * np.log(p) + (1 - yv) * np.log(1 - p))
+    assert abs(evals["v"]["binary_logloss"][-1] - ll) < 1e-5
+
+
+def test_pandas_categorical_dtype():
+    pd = pytest.importorskip("pandas")
+    X, y = _cat_data(seed=6)
+    df = pd.DataFrame({"c": pd.Categorical([f"g{int(v)}" for v in X[:, 0]]),
+                       "x": X[:, 1]})
+    ds = lgb.Dataset(df, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbose": -1}, ds, num_boost_round=10)
+    pred = bst.predict(df)
+    assert ((pred > 0.5) == y).mean() > 0.85
