@@ -643,11 +643,19 @@ class Booster:
             else self._trees
 
     def _objective_repr(self, cfg: Config) -> str:
+        """Objective line of the model text (matches the reference's
+        ObjectiveFunction::ToString tokens, e.g. ``binary sigmoid:1``,
+        ``multiclassova num_class:3 sigmoid:1``, ``regression sqrt``)."""
         o = cfg.objective
         if o == "binary":
             return f"binary sigmoid:{cfg.sigmoid:g}"
-        if o in ("multiclass", "multiclassova"):
-            return f"{o} num_class:{cfg.num_class}"
+        if o == "multiclass":
+            return f"multiclass num_class:{cfg.num_class}"
+        if o == "multiclassova":
+            return (f"multiclassova num_class:{cfg.num_class} "
+                    f"sigmoid:{cfg.sigmoid:g}")
+        if o in ("regression", "regression_l2") and cfg.reg_sqrt:
+            return "regression sqrt"
         if o == "lambdarank":
             return "lambdarank"
         return o

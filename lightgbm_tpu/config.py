@@ -486,9 +486,12 @@ class Config:
     num_devices: int = 0  # 0 = use all visible devices for data-parallel
     hist_dtype: str = "float32"  # histogram accumulator dtype
     sharding_axis: str = "data"  # mesh axis name for row sharding
-    # histogram build strategy: auto|scatter|onehot (auto: one-hot matmul
-    # on TPU — rides the MXU — and scatter-add on CPU)
+    # histogram build strategy: auto|scatter|onehot|mxu (auto: nibble
+    # matmul on TPU — rides the MXU — and scatter-add on CPU)
     hist_method: str = "auto"
+    # tree grower: compact (rows grouped by leaf; per-split work ~ leaf
+    # size) | masked (full-row masked histogram passes)
+    grower: str = "compact"
 
     # Unrecognized parameters are kept here (warned about, not fatal).
     extra: Dict[str, Any] = field(default_factory=dict)

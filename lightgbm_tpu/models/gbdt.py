@@ -121,11 +121,12 @@ class GBDTBooster:
         if hist_method == "auto":
             # tpu may surface as platform "tpu" or a tunneled plugin name
             hist_method = ("scatter" if jax.default_backend() == "cpu"
-                           else "onehot")
+                           else "mxu")
         self.grow_cfg = GrowConfig(
             num_leaves=cfg.num_leaves,
             num_bins=ds.num_total_bins(),
             max_depth=cfg.max_depth,
+            grower=cfg.grower,
             hist_method=hist_method,
             split=SplitParams(
                 lambda_l1=cfg.lambda_l1,

@@ -106,6 +106,10 @@ class Tree:
         return v <= self.threshold[node]
 
     def _cat_decision(self, node: int, v: float) -> bool:
+        # NaN routes right here but maps to bin 0 (the most frequent
+        # category) during binned training/scoring — this asymmetry is
+        # reference semantics, not a bug (tree.h:374-383 CategoricalDecision
+        # vs bin.h:612 ValueToBin's `isnan -> return 0` for categoricals).
         if np.isnan(v) or v < 0:
             return False
         iv = int(v)

@@ -30,12 +30,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .histogram import build_histogram, subtract_histogram
+from .histogram import (build_histogram, hist_from_rows,
+                        subtract_histogram)
 from .split import SplitParams, SplitResult, find_best_split, leaf_output
 
 __all__ = ["GrowConfig", "TreeArrays", "grow_tree"]
 
 NEG_INF = -jnp.inf
+
+MIN_BUCKET = 4096  # smallest compact work-window (powers of 2 upward)
 
 
 class GrowConfig(NamedTuple):
@@ -47,6 +50,10 @@ class GrowConfig(NamedTuple):
     ReduceScatter+Allreduce (data_parallel_tree_learner.cpp:284-294,
     SURVEY.md §2.6). Split finding then happens identically on every
     device (deterministic), replacing SyncUpGlobalBestSplit.
+
+    ``grower``: "compact" keeps rows grouped by leaf (DataPartition
+    analog) so per-split work is proportional to the leaf size;
+    "masked" builds every histogram with a full-row masked pass.
     """
     num_leaves: int
     num_bins: int
@@ -54,6 +61,7 @@ class GrowConfig(NamedTuple):
     split: SplitParams = SplitParams()
     hist_method: str = "scatter"
     axis_name: Optional[str] = None
+    grower: str = "compact"
 
 
 class TreeArrays(NamedTuple):
@@ -160,6 +168,56 @@ def _init_tree(L: int, B: int, dtype) -> TreeArrays:
     )
 
 
+def _apply_split_to_tree(tree: TreeArrays, best: _BestSplits, leaf, R, ns,
+                         p: SplitParams) -> TreeArrays:
+    """Record split ``ns`` of leaf slot ``leaf`` (Tree::Split, tree.h:63).
+
+    The left child keeps the parent's leaf slot; the right child takes
+    slot ``R``; internal node ``ns`` is created by this split."""
+    f = best.feature[leaf]
+    t = best.threshold_bin[leaf]
+    dl = best.default_left[leaf]
+    cm = best.cat_mask[leaf]
+    parent = tree.leaf_parent[leaf]
+    pidx = jnp.maximum(parent, 0)
+    lc = tree.left_child
+    rc = tree.right_child
+    lc = lc.at[pidx].set(jnp.where((parent >= 0) & (lc[pidx] == ~leaf),
+                                   ns, lc[pidx]))
+    rc = rc.at[pidx].set(jnp.where((parent >= 0) & (rc[pidx] == ~leaf),
+                                   ns, rc[pidx]))
+    lc = lc.at[ns].set(~leaf)
+    rc = rc.at[ns].set(~R)
+    parent_g = best.left_sum_g[leaf] + best.right_sum_g[leaf]
+    parent_h = best.left_sum_h[leaf] + best.right_sum_h[leaf]
+    parent_c = best.left_count[leaf] + best.right_count[leaf]
+    new_depth = tree.leaf_depth[leaf] + 1
+    return tree._replace(
+        split_feature=tree.split_feature.at[ns].set(f),
+        threshold_bin=tree.threshold_bin.at[ns].set(t),
+        default_left=tree.default_left.at[ns].set(dl),
+        split_is_cat=tree.split_is_cat.at[ns].set(best.is_cat[leaf]),
+        split_cat_mask=tree.split_cat_mask.at[ns].set(cm),
+        left_child=lc,
+        right_child=rc,
+        split_gain=tree.split_gain.at[ns].set(best.gain[leaf]),
+        internal_value=tree.internal_value.at[ns].set(
+            leaf_output(parent_g, parent_h, p)),
+        internal_weight=tree.internal_weight.at[ns].set(parent_h),
+        internal_count=tree.internal_count.at[ns].set(parent_c),
+        leaf_value=tree.leaf_value.at[leaf].set(best.left_output[leaf])
+        .at[R].set(best.right_output[leaf]),
+        leaf_weight=tree.leaf_weight.at[leaf].set(best.left_sum_h[leaf])
+        .at[R].set(best.right_sum_h[leaf]),
+        leaf_count=tree.leaf_count.at[leaf].set(best.left_count[leaf])
+        .at[R].set(best.right_count[leaf]),
+        leaf_parent=tree.leaf_parent.at[leaf].set(ns).at[R].set(ns),
+        leaf_depth=tree.leaf_depth.at[leaf].set(new_depth)
+        .at[R].set(new_depth),
+        num_leaves=tree.num_leaves + 1,
+    )
+
+
 def grow_tree_impl(cfg: GrowConfig,
                    bins_T: jnp.ndarray,
                    grad: jnp.ndarray,
@@ -179,6 +237,26 @@ def grow_tree_impl(cfg: GrowConfig,
       feature_mask: [F] bool usable-feature mask (feature_fraction etc).
       feat_num_bins / feat_nan_bin: [F] i32 per-feature bin metadata.
     """
+    if cfg.grower == "compact":
+        return _grow_compact_impl(cfg, bins_T, grad, hess, row_weight,
+                                  feature_mask, feat_num_bins, feat_nan_bin,
+                                  monotone_constraints, feat_is_cat)
+    return _grow_masked_impl(cfg, bins_T, grad, hess, row_weight,
+                             feature_mask, feat_num_bins, feat_nan_bin,
+                             monotone_constraints, feat_is_cat)
+
+
+def _grow_masked_impl(cfg: GrowConfig,
+                      bins_T: jnp.ndarray,
+                      grad: jnp.ndarray,
+                      hess: jnp.ndarray,
+                      row_weight: jnp.ndarray,
+                      feature_mask: jnp.ndarray,
+                      feat_num_bins: jnp.ndarray,
+                      feat_nan_bin: jnp.ndarray,
+                      monotone_constraints: Optional[jnp.ndarray] = None,
+                      feat_is_cat: Optional[jnp.ndarray] = None):
+    """Masked-pass grower: every histogram is a full-row masked pass."""
     L = cfg.num_leaves
     B = cfg.num_bins
     F = bins_T.shape[0]
@@ -242,44 +320,8 @@ def grow_tree_impl(cfg: GrowConfig,
         row_leaf = jnp.where(on_leaf & ~go_left, R, row_leaf)
 
         # -- tree arrays update (Tree::Split, tree.h:63) --
-        parent = tree.leaf_parent[leaf]
-        pidx = jnp.maximum(parent, 0)
-        lc = tree.left_child
-        rc = tree.right_child
-        lc = lc.at[pidx].set(jnp.where((parent >= 0) & (lc[pidx] == ~leaf),
-                                       ns, lc[pidx]))
-        rc = rc.at[pidx].set(jnp.where((parent >= 0) & (rc[pidx] == ~leaf),
-                                       ns, rc[pidx]))
-        lc = lc.at[ns].set(~leaf)
-        rc = rc.at[ns].set(~R)
-        parent_g = best.left_sum_g[leaf] + best.right_sum_g[leaf]
-        parent_h = best.left_sum_h[leaf] + best.right_sum_h[leaf]
-        parent_c = best.left_count[leaf] + best.right_count[leaf]
         new_depth = tree.leaf_depth[leaf] + 1
-        tree = tree._replace(
-            split_feature=tree.split_feature.at[ns].set(f),
-            threshold_bin=tree.threshold_bin.at[ns].set(t),
-            default_left=tree.default_left.at[ns].set(dl),
-            split_is_cat=tree.split_is_cat.at[ns].set(best.is_cat[leaf]),
-            split_cat_mask=tree.split_cat_mask.at[ns].set(cm),
-            left_child=lc,
-            right_child=rc,
-            split_gain=tree.split_gain.at[ns].set(best.gain[leaf]),
-            internal_value=tree.internal_value.at[ns].set(
-                leaf_output(parent_g, parent_h, p)),
-            internal_weight=tree.internal_weight.at[ns].set(parent_h),
-            internal_count=tree.internal_count.at[ns].set(parent_c),
-            leaf_value=tree.leaf_value.at[leaf].set(best.left_output[leaf])
-            .at[R].set(best.right_output[leaf]),
-            leaf_weight=tree.leaf_weight.at[leaf].set(best.left_sum_h[leaf])
-            .at[R].set(best.right_sum_h[leaf]),
-            leaf_count=tree.leaf_count.at[leaf].set(best.left_count[leaf])
-            .at[R].set(best.right_count[leaf]),
-            leaf_parent=tree.leaf_parent.at[leaf].set(ns).at[R].set(ns),
-            leaf_depth=tree.leaf_depth.at[leaf].set(new_depth)
-            .at[R].set(new_depth),
-            num_leaves=tree.num_leaves + 1,
-        )
+        tree = _apply_split_to_tree(tree, best, leaf, R, ns, p)
 
         # -- histograms: scatter the smaller child, subtract for sibling --
         left_smaller = best.left_count[leaf] <= best.right_count[leaf]
@@ -311,6 +353,217 @@ def grow_tree_impl(cfg: GrowConfig,
 
     state = lax.fori_loop(0, L - 1, step, state)
     return state.tree, state.row_leaf
+
+
+# ---------------------------------------------------------------------------
+# Compact grower: rows grouped by leaf (DataPartition re-imagined)
+# ---------------------------------------------------------------------------
+
+def _bucket_sizes(n: int) -> list:
+    """Power-of-2 work-window sizes up to n (n itself is the top window).
+
+    The compact grower's dynamic leaf ranges are processed through
+    static-shape windows (XLA needs static shapes); a leaf of size s pays
+    for the smallest window >= s, i.e. at most 2x the optimal work."""
+    sizes = []
+    s = MIN_BUCKET
+    while s < n:
+        sizes.append(s)
+        s *= 2
+    sizes.append(n)
+    return sizes
+
+
+class _CompactState(NamedTuple):
+    tree: TreeArrays
+    best: _BestSplits
+    hists: jnp.ndarray       # [L, F, B, 3]
+    order: jnp.ndarray       # [n] i32 — row ids grouped by leaf
+    leaf_begin: jnp.ndarray  # [L] i32 (local raw offsets)
+    leaf_count: jnp.ndarray  # [L] i32 (local raw counts)
+    num_splits: jnp.ndarray  # scalar i32
+
+
+def _row_leaf_from_order(order, leaf_begin, leaf_count, n, L):
+    """Recover the per-row leaf assignment from the grouped order:
+    ranges partition [0, n); mark each active range start, prefix-sum to
+    a segment id, map segments to leaves via the begin-sorted leaf list."""
+    active = leaf_count > 0
+    keys = jnp.where(active, leaf_begin, n + 1)
+    ls = jnp.argsort(keys)  # leaves ordered by begin, inactive last
+    flag = active[ls].astype(jnp.int32)
+    marks = jnp.zeros((n,), jnp.int32).at[
+        jnp.clip(leaf_begin[ls], 0, n - 1)].add(flag)
+    seg = jnp.cumsum(marks) - 1
+    leaf_of_pos = ls[jnp.clip(seg, 0, L - 1)].astype(jnp.int32)
+    return jnp.zeros((n,), jnp.int32).at[order].set(
+        leaf_of_pos, unique_indices=True)
+
+
+def _grow_compact_impl(cfg: GrowConfig,
+                       bins_T: jnp.ndarray,
+                       grad: jnp.ndarray,
+                       hess: jnp.ndarray,
+                       row_weight: jnp.ndarray,
+                       feature_mask: jnp.ndarray,
+                       feat_num_bins: jnp.ndarray,
+                       feat_nan_bin: jnp.ndarray,
+                       monotone_constraints: Optional[jnp.ndarray] = None,
+                       feat_is_cat: Optional[jnp.ndarray] = None):
+    """Leaf-wise growth with rows kept grouped by leaf.
+
+    The reference's DataPartition (data_partition.hpp) + CUDA partition
+    (cuda_data_partition.cu) analog: an ``order`` array holds row ids
+    grouped by leaf so each split's histogram gathers only that leaf's
+    rows (cost ~ leaf size, not n). Histograms ride the MXU via the
+    nibble decomposition (histogram.py). Partitioning is a stable
+    argsort of a 4-way key inside a clamped static window."""
+    L = cfg.num_leaves
+    B = cfg.num_bins
+    F = bins_T.shape[0]
+    n = bins_T.shape[1]
+    dtype = grad.dtype
+    p = cfg.split
+    sizes = _bucket_sizes(n)
+    sizes_arr = jnp.asarray(sizes, jnp.int32)
+
+    def psum(x):
+        return lax.psum(x, cfg.axis_name) if cfg.axis_name else x
+
+    def best_for(hist, sg, sh, sc):
+        return find_best_split(hist, sg, sh, sc, feat_num_bins, feat_nan_bin,
+                               feature_mask, p, monotone_constraints,
+                               feat_is_cat)
+
+    bins_rm = bins_T.T                      # [n, F] row-major for gathers
+    w = row_weight.astype(dtype)
+    gw3 = jnp.stack([grad * w, hess * w, w], axis=-1)  # [n, 3]
+    # "onehot" has no gathered-rows analog; it maps to the MXU kernel
+    hmethod = "scatter" if cfg.hist_method == "scatter" else "mxu"
+
+    def bucket_idx(size):
+        return jnp.clip(jnp.sum(size > sizes_arr), 0, len(sizes) - 1)
+
+    def make_part(S):
+        def br(order, start, cnt, f, t, dl, isc, cm):
+            start_c = jnp.clip(start, 0, n - S)
+            rel = start - start_c
+            idx = lax.dynamic_slice(order, (start_c,), (S,))
+            col_full = lax.dynamic_index_in_dim(
+                bins_T, f, axis=0, keepdims=False)
+            col = col_full[idx].astype(jnp.int32)
+            nanb = feat_nan_bin[f]
+            gl_num = jnp.where((nanb >= 0) & (col == nanb), dl, col <= t)
+            gl = jnp.where(isc, cm[col], gl_num)
+            pos = jnp.arange(S)
+            inp = (pos >= rel) & (pos < rel + cnt)
+            # stable 4-way key: rows before/after the leaf's range keep
+            # their positions; in-range rows split left(1) / right(2)
+            key = jnp.where(inp, jnp.where(gl, 1, 2),
+                            jnp.where(pos < rel, 0, 3))
+            perm = jnp.argsort(key, stable=True)
+            order2 = lax.dynamic_update_slice(order, idx[perm], (start_c,))
+            n_left = jnp.sum((inp & gl).astype(jnp.int32))
+            return order2, n_left
+        return br
+
+    def make_hist(S):
+        def br(order, start, cnt):
+            start_c = jnp.clip(start, 0, n - S)
+            rel = start - start_c
+            idx = lax.dynamic_slice(order, (start_c,), (S,))
+            pos = jnp.arange(S)
+            inp = (pos >= rel) & (pos < rel + cnt)
+            rows = jnp.take(bins_rm, idx, axis=0)
+            pay = jnp.take(gw3, idx, axis=0) * inp[:, None].astype(dtype)
+            return hist_from_rows(rows, pay, B, hmethod)
+        return br
+
+    part_branches = [make_part(S) for S in sizes]
+    hist_branches = [make_hist(S) for S in sizes]
+
+    # ---- root ----
+    total_g = psum(jnp.sum(gw3[:, 0]))
+    total_h = psum(jnp.sum(gw3[:, 1]))
+    total_c = psum(jnp.sum(gw3[:, 2]))
+    root_hist = psum(hist_from_rows(bins_rm, gw3, B, hmethod))
+
+    tree = _init_tree(L, B, dtype)
+    tree = tree._replace(
+        leaf_value=tree.leaf_value.at[0].set(leaf_output(total_g, total_h, p)),
+        leaf_weight=tree.leaf_weight.at[0].set(total_h),
+        leaf_count=tree.leaf_count.at[0].set(total_c),
+    )
+    best = _BestSplits.init(L, B, dtype)
+    best = best.store(0, best_for(root_hist, total_g, total_h, total_c),
+                      jnp.asarray(True))
+    hists = jnp.zeros((L, F, B, 3), dtype).at[0].set(root_hist)
+    state = _CompactState(
+        tree=tree, best=best, hists=hists,
+        order=jnp.arange(n, dtype=jnp.int32),
+        leaf_begin=jnp.zeros((L,), jnp.int32),
+        leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(n),
+        num_splits=jnp.asarray(0, jnp.int32))
+
+    def depth_ok(d):
+        if cfg.max_depth <= 0:
+            return jnp.asarray(True)
+        return d < cfg.max_depth
+
+    def do_split(state: _CompactState) -> _CompactState:
+        tree, best, hists, order, lbegin, lcount, ns = state
+        leaf = jnp.argmax(best.gain).astype(jnp.int32)
+        R = ns + 1
+        start = lbegin[leaf]
+        cnt = lcount[leaf]
+
+        # -- partition the leaf's range (DataPartition::Split analog) --
+        order, n_left = lax.switch(
+            bucket_idx(cnt), part_branches, order, start, cnt,
+            best.feature[leaf], best.threshold_bin[leaf],
+            best.default_left[leaf], best.is_cat[leaf],
+            best.cat_mask[leaf])
+        lbegin = lbegin.at[R].set(start + n_left)
+        lcount = lcount.at[leaf].set(n_left).at[R].set(cnt - n_left)
+
+        new_depth = tree.leaf_depth[leaf] + 1
+        tree = _apply_split_to_tree(tree, best, leaf, R, ns, p)
+
+        # -- histogram the smaller child; sibling by subtraction.
+        # "smaller" is decided on GLOBAL weighted counts so every shard
+        # histograms the same side (SyncUpGlobalBestSplit determinism).
+        left_smaller = best.left_count[leaf] <= best.right_count[leaf]
+        s_start = jnp.where(left_smaller, start, start + n_left)
+        s_cnt = jnp.where(left_smaller, n_left, cnt - n_left)
+        small_hist = psum(lax.switch(
+            bucket_idx(s_cnt), hist_branches, order, s_start, s_cnt))
+        parent_hist = hists[leaf]
+        big_hist = subtract_histogram(parent_hist, small_hist)
+        left_hist = jnp.where(left_smaller, small_hist, big_hist)
+        right_hist = jnp.where(left_smaller, big_hist, small_hist)
+        hists = hists.at[leaf].set(left_hist).at[R].set(right_hist)
+
+        # -- child best splits --
+        can_go_deeper = depth_ok(new_depth)
+        rl = best_for(left_hist, best.left_sum_g[leaf],
+                      best.left_sum_h[leaf], best.left_count[leaf])
+        rr = best_for(right_hist, best.right_sum_g[leaf],
+                      best.right_sum_h[leaf], best.right_count[leaf])
+        best = best.store(leaf, rl, can_go_deeper)
+        best = best.store(R, rr, can_go_deeper)
+
+        return _CompactState(tree=tree, best=best, hists=hists, order=order,
+                             leaf_begin=lbegin, leaf_count=lcount,
+                             num_splits=ns + 1)
+
+    def step(_, state: _CompactState) -> _CompactState:
+        can = jnp.max(state.best.gain) > 0.0
+        return lax.cond(can, do_split, lambda s: s, state)
+
+    state = lax.fori_loop(0, L - 1, step, state)
+    row_leaf = _row_leaf_from_order(state.order, state.leaf_begin,
+                                    state.leaf_count, n, L)
+    return state.tree, row_leaf
 
 
 grow_tree = jax.jit(grow_tree_impl, static_argnames=("cfg",))

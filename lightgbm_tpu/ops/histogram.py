@@ -26,7 +26,100 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["build_histogram", "subtract_histogram"]
+__all__ = ["build_histogram", "subtract_histogram", "hist_from_rows",
+           "PACK"]
+
+PACK = 8          # features per MXU pack (PACK * 16 = 128 lanes)
+ROW_BLOCK = 8192  # rows per accumulation block (bounds one-hot residency)
+
+
+def _nibble_hist_block(rows: jnp.ndarray, payload: jnp.ndarray,
+                       s_hi: int) -> jnp.ndarray:
+    """One row-block of the nibble-decomposed MXU histogram.
+
+    ``hist[f, b] = sum_r [bins[r,f]==b] * payload[r]`` with ``b = 16*hi+lo``
+    factors into ``sum_r HI[r, f*s_hi+hi] * LO[r, f*16+lo] * payload[r]``:
+    a dense [x, S] x [S, y*c] batched matmul over PACK-feature groups —
+    the MXU replacement for the CUDA shared-memory scatter-add
+    (/root/reference/src/treelearner/cuda/cuda_histogram_constructor.cu:18).
+    Cross-feature (p != q) blocks of the product are computed and
+    discarded; the MXU does them for free within the 128-lane tile.
+
+    Args:
+      rows: ``[S, npacks, PACK]`` int32 bin values.
+      payload: ``[S, C]`` float channels (g*w, h*w, w).
+    Returns:
+      ``[npacks, PACK, s_hi * 16, C]`` partial histograms.
+    """
+    S, npacks, P = rows.shape
+    C = payload.shape[-1]
+    dtype = payload.dtype
+    hi = rows // 16
+    lo = rows & 15
+    HI = (hi[..., None] == jnp.arange(s_hi)).astype(dtype)      # [S,np,P,hi]
+    LO = (lo[..., None] == jnp.arange(16)).astype(dtype)        # [S,np,P,16]
+    LOC = LO[..., None] * payload[:, None, None, None, :]       # [S,np,P,16,C]
+    out = jnp.einsum(
+        "snx,snyc->nxyc",
+        HI.reshape(S, npacks, P * s_hi),
+        LOC.reshape(S, npacks, P * 16, C),
+        preferred_element_type=dtype,
+        precision=lax.Precision.HIGHEST)       # [np, P*s_hi, P*16, C]
+    d = jnp.diagonal(out.reshape(npacks, P, s_hi, P, 16, C),
+                     axis1=1, axis2=3)                        # [np,hi,16,C,P]
+    return d.transpose(0, 4, 1, 2, 3).reshape(npacks, P, s_hi * 16, C)
+
+
+def hist_from_rows(rows: jnp.ndarray, payload: jnp.ndarray,
+                   num_bins: int, method: str = "mxu") -> jnp.ndarray:
+    """Histogram over a row-block matrix.
+
+    Args:
+      rows: ``[S, F]`` integer bin matrix (row-major).
+      payload: ``[S, C]`` float per-row channels.
+      num_bins: B.
+      method: "mxu" (nibble matmul) or "scatter" (CPU-friendly).
+    Returns:
+      ``[F, B, C]`` histograms (padding features report zeros only if the
+      caller masked their payload; callers crop to the true F).
+    """
+    if method == "scatter":
+        return _hist_scatter(rows.T, payload, num_bins)
+    S, F = rows.shape
+    C = payload.shape[-1]
+    s_hi = -(-num_bins // 16)
+    f_pad = (-F) % PACK
+    if f_pad:
+        rows = jnp.pad(rows, ((0, 0), (0, f_pad)))
+    Fp = F + f_pad
+    npacks = Fp // PACK
+    rows = rows.astype(jnp.int32).reshape(S, npacks, PACK)
+
+    if S <= ROW_BLOCK:
+        h = _nibble_hist_block(rows, payload, s_hi)
+    else:
+        nblk = -(-S // ROW_BLOCK)
+        pad = nblk * ROW_BLOCK - S
+        if pad:
+            rows = jnp.pad(rows, ((0, pad), (0, 0), (0, 0)))
+            payload = jnp.pad(payload, ((0, pad), (0, 0)))
+        rows_b = rows.reshape(nblk, ROW_BLOCK, npacks, PACK)
+        pay_b = payload.reshape(nblk, ROW_BLOCK, C)
+
+        def body(acc, xs):
+            r, p = xs
+            return acc + _nibble_hist_block(r, p, s_hi), None
+
+        init = jnp.zeros((npacks, PACK, s_hi * 16, C), payload.dtype)
+        h, _ = lax.scan(body, init, (rows_b, pay_b))
+    h = h.reshape(Fp, s_hi * 16, C)
+    return h[:F, :num_bins, :]
+
+
+def _hist_mxu(bins_T: jnp.ndarray, gh: jnp.ndarray,
+              num_bins: int) -> jnp.ndarray:
+    """Full-pass MXU histogram from the feature-major bin matrix."""
+    return hist_from_rows(bins_T.T, gh, num_bins)
 
 
 def _hist_scatter(bins_T: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
@@ -97,6 +190,8 @@ def build_histogram(bins_T: jnp.ndarray,
     gh = jnp.stack([grad * m, hess * m, m], axis=-1)  # [n, 3]
     if method == "onehot":
         return _hist_onehot(bins_T, gh, num_bins)
+    if method == "mxu":
+        return _hist_mxu(bins_T, gh, num_bins)
     return _hist_scatter(bins_T, gh, num_bins)
 
 

@@ -1,0 +1,102 @@
+"""Cross-checks between the two growers and the three histogram methods.
+
+The masked grower + scatter histogram is the simple reference
+implementation; the compact grower + MXU nibble histogram is the fast
+TPU path. They must agree exactly on tree structure (the reference's
+cpu-vs-gpu parity tests, tests/python_package_test/test_dual.py, are the
+model for this).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.grow import GrowConfig, grow_tree
+from lightgbm_tpu.ops.histogram import build_histogram
+from lightgbm_tpu.ops.split import SplitParams
+
+
+def _mk(n, F, B, seed=0, with_nan_bin=False):
+    rs = np.random.RandomState(seed)
+    bins = rs.randint(0, B, size=(F, n)).astype(np.uint8)
+    g = rs.randn(n).astype(np.float32)
+    h = (np.abs(rs.randn(n)) + 0.1).astype(np.float32)
+    w = np.ones(n, np.float32)
+    fnb = np.full(F, B, np.int32)
+    fnan = np.full(F, -1, np.int32)
+    if with_nan_bin:
+        fnan[::2] = B - 1
+    return (jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+            jnp.asarray(w), jnp.ones((F,), bool), jnp.asarray(fnb),
+            jnp.asarray(fnan))
+
+
+@pytest.mark.parametrize("method", ["onehot", "mxu"])
+def test_hist_methods_match_scatter(method):
+    rs = np.random.RandomState(3)
+    F, n, B = 11, 5000, 67
+    bins_T = jnp.asarray(rs.randint(0, B, size=(F, n)).astype(np.uint8))
+    g = jnp.asarray(rs.randn(n).astype(np.float32))
+    h = jnp.asarray(rs.rand(n).astype(np.float32))
+    w = jnp.asarray((rs.rand(n) > 0.3).astype(np.float32) * 1.7)
+    mask = jnp.asarray(rs.rand(n) > 0.5)
+    a = build_histogram(bins_T, g, h, w, mask, B, "scatter")
+    b = build_histogram(bins_T, g, h, w, mask, B, method)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               atol=2e-3, rtol=1e-4)
+
+
+def test_hist_mxu_blocked_path():
+    """Row counts above ROW_BLOCK exercise the scan accumulation."""
+    rs = np.random.RandomState(4)
+    F, n, B = 3, 20000, 256
+    bins_T = jnp.asarray(rs.randint(0, B, size=(F, n)).astype(np.uint8))
+    g = jnp.asarray(rs.randn(n).astype(np.float32))
+    h = jnp.asarray(rs.rand(n).astype(np.float32))
+    ones = jnp.ones((n,))
+    a = build_histogram(bins_T, g, h, ones, ones.astype(bool), B, "scatter")
+    b = build_histogram(bins_T, g, h, ones, ones.astype(bool), B, "mxu")
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               atol=4e-3, rtol=1e-4)
+
+
+@pytest.mark.parametrize("with_nan", [False, True])
+def test_compact_grower_matches_masked(with_nan):
+    args = _mk(3000, 6, 64, seed=1, with_nan_bin=with_nan)
+    cfg_m = GrowConfig(num_leaves=15, num_bins=64,
+                       split=SplitParams(min_data_in_leaf=5.0),
+                       grower="masked", hist_method="scatter")
+    cfg_c = cfg_m._replace(grower="compact")
+    tm, rlm = grow_tree(cfg_m, *args)
+    tc, rlc = grow_tree(cfg_c, *args)
+    assert int(tm.num_leaves) == int(tc.num_leaves)
+    for name in ("split_feature", "threshold_bin", "default_left",
+                 "left_child", "right_child", "leaf_count", "leaf_parent"):
+        np.testing.assert_array_equal(np.asarray(getattr(tm, name)),
+                                      np.asarray(getattr(tc, name)),
+                                      err_msg=name)
+    for name in ("leaf_value", "split_gain", "leaf_weight"):
+        np.testing.assert_allclose(np.asarray(getattr(tm, name)),
+                                   np.asarray(getattr(tc, name)),
+                                   atol=1e-4, rtol=1e-4, err_msg=name)
+    np.testing.assert_array_equal(np.asarray(rlm), np.asarray(rlc))
+
+
+def test_compact_grower_weighted_rows():
+    """Bagging-style zero/amplified weights flow through the compact
+    partition (weighted counts gate splits; raw rows stay in ranges)."""
+    (bins, g, h, _, fm, fnb, fnan) = _mk(4000, 5, 32, seed=2)
+    rs = np.random.RandomState(9)
+    w = jnp.asarray((rs.rand(4000) > 0.4).astype(np.float32) * 1.5)
+    cfg_m = GrowConfig(num_leaves=10, num_bins=32,
+                       split=SplitParams(min_data_in_leaf=5.0),
+                       grower="masked", hist_method="scatter")
+    cfg_c = cfg_m._replace(grower="compact")
+    tm, rlm = grow_tree(cfg_m, bins, g, h, w, fm, fnb, fnan)
+    tc, rlc = grow_tree(cfg_c, bins, g, h, w, fm, fnb, fnan)
+    np.testing.assert_array_equal(np.asarray(tm.split_feature),
+                                  np.asarray(tc.split_feature))
+    np.testing.assert_array_equal(np.asarray(rlm), np.asarray(rlc))
+    np.testing.assert_allclose(np.asarray(tm.leaf_value),
+                               np.asarray(tc.leaf_value),
+                               atol=1e-4, rtol=1e-4)
