@@ -455,6 +455,16 @@ class Dataset:
             return None
         return np.diff(self._query_boundaries)
 
+    def get_position(self):
+        """Per-row result-list positions for position-debiased LTR
+        (Metadata::positions, dataset.h:48-398)."""
+        return self.position
+
+    def set_position(self, position) -> "Dataset":
+        self.position = None if position is None else \
+            np.asarray(position).ravel()
+        return self
+
     def query_boundaries(self) -> Optional[np.ndarray]:
         self.construct()
         return self._query_boundaries
@@ -765,8 +775,13 @@ class Booster:
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration > 0 \
                 else -1
-        return predict_any(self, data, start_iteration, num_iteration,
-                           raw_score, pred_leaf, pred_contrib)
+        return predict_any(
+            self, data, start_iteration, num_iteration,
+            raw_score, pred_leaf, pred_contrib,
+            pred_early_stop=bool(kwargs.get("pred_early_stop", False)),
+            pred_early_stop_freq=int(kwargs.get("pred_early_stop_freq", 10)),
+            pred_early_stop_margin=float(
+                kwargs.get("pred_early_stop_margin", 10.0)))
 
     # -- model io ----------------------------------------------------------
     def model_to_string(self, num_iteration: Optional[int] = None,

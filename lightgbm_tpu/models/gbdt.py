@@ -122,12 +122,19 @@ class GBDTBooster:
             # tpu may surface as platform "tpu" or a tunneled plugin name
             hist_method = ("scatter" if jax.default_backend() == "cpu"
                            else "mxu")
+        grower = cfg.grower
+        if cfg.use_quantized_grad and grower != "compact":
+            grower = "compact"  # quantized histograms are compact-only
         self.grow_cfg = GrowConfig(
             num_leaves=cfg.num_leaves,
             num_bins=ds.num_total_bins(),
             max_depth=cfg.max_depth,
-            grower=cfg.grower,
+            grower=grower,
             hist_method=hist_method,
+            quantized=cfg.use_quantized_grad,
+            quant_bins=cfg.num_grad_quant_bins,
+            renew_leaf=cfg.quant_train_renew_leaf,
+            stochastic=cfg.stochastic_rounding,
             split=SplitParams(
                 lambda_l1=cfg.lambda_l1,
                 lambda_l2=cfg.lambda_l2,
@@ -162,7 +169,8 @@ class GBDTBooster:
                                       ((0, 0), (0, self._pad)))
             self._grow_fn = make_dp_grow_fn(
                 self.grow_cfg, self.mesh, self.monotone is not None,
-                self.feat_is_cat is not None)
+                self.feat_is_cat is not None,
+                cfg.use_quantized_grad and cfg.stochastic_rounding)
 
         seed = cfg.seed if cfg.seed is not None else 0
         self._base_key = jax.random.PRNGKey(seed)
@@ -381,6 +389,9 @@ class GBDTBooster:
 
         shrinkage = self._shrinkage if cfg.boosting != "rf" else 1.0
         grew_any = False
+        quant_key = None
+        if cfg.use_quantized_grad and cfg.stochastic_rounding:
+            quant_key = jax.random.fold_in(self._base_key, it)
         for k in range(self.K):
             if self.mesh is not None:
                 gk = grad[k]
@@ -396,13 +407,17 @@ class GBDTBooster:
                     args = args + (self.monotone,)
                 if self.feat_is_cat is not None:
                     args = args + (self.feat_is_cat,)
+                if quant_key is not None:
+                    args = args + (jax.random.fold_in(quant_key, k),)
                 dev_tree, row_leaf = self._grow_fn(*args)
                 row_leaf = row_leaf[: self.n]
             else:
                 dev_tree, row_leaf = grow_tree(
                     self.grow_cfg, self.bins_T, grad[k], hess[k], row_w,
                     fmask, self.feat_num_bins, self.feat_nan_bin,
-                    self.monotone, self.feat_is_cat)
+                    self.monotone, self.feat_is_cat,
+                    None if quant_key is None
+                    else jax.random.fold_in(quant_key, k))
             num_leaves = int(np.asarray(dev_tree.num_leaves))
             if num_leaves <= 1:
                 # constant tree; carries the boost_from_average bias when

@@ -31,7 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .histogram import (build_histogram, hist_from_rows,
-                        subtract_histogram)
+                        hist_from_rows_int, subtract_histogram)
 from .split import SplitParams, SplitResult, find_best_split, leaf_output
 
 __all__ = ["GrowConfig", "TreeArrays", "grow_tree"]
@@ -62,6 +62,13 @@ class GrowConfig(NamedTuple):
     hist_method: str = "scatter"
     axis_name: Optional[str] = None
     grower: str = "compact"
+    # quantized-gradient training (use_quantized_grad; the reference's
+    # GradientDiscretizer, gradient_discretizer.hpp): g/h discretized to
+    # int8, histograms accumulate in exact int32 on the int MXU.
+    quantized: bool = False
+    quant_bins: int = 4          # num_grad_quant_bins
+    renew_leaf: bool = False     # quant_train_renew_leaf
+    stochastic: bool = True      # stochastic_rounding
 
 
 class TreeArrays(NamedTuple):
@@ -227,7 +234,8 @@ def grow_tree_impl(cfg: GrowConfig,
                    feat_num_bins: jnp.ndarray,
                    feat_nan_bin: jnp.ndarray,
                    monotone_constraints: Optional[jnp.ndarray] = None,
-                   feat_is_cat: Optional[jnp.ndarray] = None):
+                   feat_is_cat: Optional[jnp.ndarray] = None,
+                   quant_key: Optional[jnp.ndarray] = None):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf).
 
     Args:
@@ -236,11 +244,14 @@ def grow_tree_impl(cfg: GrowConfig,
       row_weight: [n] float sampling weight (bagging/GOSS; 1.0 = use row).
       feature_mask: [F] bool usable-feature mask (feature_fraction etc).
       feat_num_bins / feat_nan_bin: [F] i32 per-feature bin metadata.
+      quant_key: PRNG key for stochastic gradient rounding (quantized
+        mode only).
     """
     if cfg.grower == "compact":
         return _grow_compact_impl(cfg, bins_T, grad, hess, row_weight,
                                   feature_mask, feat_num_bins, feat_nan_bin,
-                                  monotone_constraints, feat_is_cat)
+                                  monotone_constraints, feat_is_cat,
+                                  quant_key)
     return _grow_masked_impl(cfg, bins_T, grad, hess, row_weight,
                              feature_mask, feat_num_bins, feat_nan_bin,
                              monotone_constraints, feat_is_cat)
@@ -409,7 +420,8 @@ def _grow_compact_impl(cfg: GrowConfig,
                        feat_num_bins: jnp.ndarray,
                        feat_nan_bin: jnp.ndarray,
                        monotone_constraints: Optional[jnp.ndarray] = None,
-                       feat_is_cat: Optional[jnp.ndarray] = None):
+                       feat_is_cat: Optional[jnp.ndarray] = None,
+                       quant_key: Optional[jnp.ndarray] = None):
     """Leaf-wise growth with rows kept grouped by leaf.
 
     The reference's DataPartition (data_partition.hpp) + CUDA partition
@@ -440,6 +452,39 @@ def _grow_compact_impl(cfg: GrowConfig,
     gw3 = jnp.stack([grad * w, hess * w, w], axis=-1)  # [n, 3]
     # "onehot" has no gathered-rows analog; it maps to the MXU kernel
     hmethod = "scatter" if cfg.hist_method == "scatter" else "mxu"
+
+    quant = cfg.quantized
+    if quant:
+        # GradientDiscretizer analog (gradient_discretizer.hpp:35):
+        # per-tree scales, stochastic rounding, int8 payload. Counts are
+        # in-bag row counts (the reference also counts rows, not weights,
+        # on the quantized path).
+        def pmax(x):
+            return lax.pmax(x, cfg.axis_name) if cfg.axis_name else x
+
+        half = max(1, cfg.quant_bins // 2)
+        gs = jnp.maximum(pmax(jnp.max(jnp.abs(gw3[:, 0]))), 1e-30) / half
+        hs = jnp.maximum(pmax(jnp.max(gw3[:, 1])), 1e-30) \
+            / max(1, cfg.quant_bins)
+        if cfg.stochastic and quant_key is not None:
+            k = quant_key
+            if cfg.axis_name:
+                k = jax.random.fold_in(k, lax.axis_index(cfg.axis_name))
+            u = jax.random.uniform(k, (n, 2), dtype)
+        else:
+            u = jnp.full((n, 2), 0.5, dtype)
+        gq = jnp.clip(jnp.floor(gw3[:, 0] / gs + u[:, 0]), -127, 127)
+        hq = jnp.clip(jnp.floor(gw3[:, 1] / hs + u[:, 1]), 0, 127)
+        wq = (w > 0)
+        gw3_q = jnp.stack([gq, hq, wq.astype(dtype)],
+                          axis=-1).astype(jnp.int8)
+        scale3 = jnp.stack([gs, hs, jnp.asarray(1.0, dtype)])
+
+    def hist_f(h):
+        """int32 histogram -> float stats for split search."""
+        if quant:
+            return h.astype(dtype) * scale3[None, None, :]
+        return h
 
     def bucket_idx(size):
         return jnp.clip(jnp.sum(size > sizes_arr), 0, len(sizes) - 1)
@@ -475,6 +520,10 @@ def _grow_compact_impl(cfg: GrowConfig,
             pos = jnp.arange(S)
             inp = (pos >= rel) & (pos < rel + cnt)
             rows = jnp.take(bins_rm, idx, axis=0)
+            if quant:
+                pay = jnp.take(gw3_q, idx, axis=0) \
+                    * inp[:, None].astype(jnp.int8)
+                return hist_from_rows_int(rows, pay, B, hmethod)
             pay = jnp.take(gw3, idx, axis=0) * inp[:, None].astype(dtype)
             return hist_from_rows(rows, pay, B, hmethod)
         return br
@@ -483,10 +532,15 @@ def _grow_compact_impl(cfg: GrowConfig,
     hist_branches = [make_hist(S) for S in sizes]
 
     # ---- root ----
-    total_g = psum(jnp.sum(gw3[:, 0]))
-    total_h = psum(jnp.sum(gw3[:, 1]))
-    total_c = psum(jnp.sum(gw3[:, 2]))
-    root_hist = psum(hist_from_rows(bins_rm, gw3, B, hmethod))
+    if quant:
+        root_hist = psum(hist_from_rows_int(bins_rm, gw3_q, B, hmethod))
+        sums = hist_f(root_hist)[0].sum(axis=0)  # every row hits feature 0
+        total_g, total_h, total_c = sums[0], sums[1], sums[2]
+    else:
+        total_g = psum(jnp.sum(gw3[:, 0]))
+        total_h = psum(jnp.sum(gw3[:, 1]))
+        total_c = psum(jnp.sum(gw3[:, 2]))
+        root_hist = psum(hist_from_rows(bins_rm, gw3, B, hmethod))
 
     tree = _init_tree(L, B, dtype)
     tree = tree._replace(
@@ -495,9 +549,11 @@ def _grow_compact_impl(cfg: GrowConfig,
         leaf_count=tree.leaf_count.at[0].set(total_c),
     )
     best = _BestSplits.init(L, B, dtype)
-    best = best.store(0, best_for(root_hist, total_g, total_h, total_c),
+    best = best.store(0, best_for(hist_f(root_hist), total_g, total_h,
+                                  total_c),
                       jnp.asarray(True))
-    hists = jnp.zeros((L, F, B, 3), dtype).at[0].set(root_hist)
+    hists = jnp.zeros((L, F, B, 3),
+                      jnp.int32 if quant else dtype).at[0].set(root_hist)
     state = _CompactState(
         tree=tree, best=best, hists=hists,
         order=jnp.arange(n, dtype=jnp.int32),
@@ -545,9 +601,9 @@ def _grow_compact_impl(cfg: GrowConfig,
 
         # -- child best splits --
         can_go_deeper = depth_ok(new_depth)
-        rl = best_for(left_hist, best.left_sum_g[leaf],
+        rl = best_for(hist_f(left_hist), best.left_sum_g[leaf],
                       best.left_sum_h[leaf], best.left_count[leaf])
-        rr = best_for(right_hist, best.right_sum_g[leaf],
+        rr = best_for(hist_f(right_hist), best.right_sum_g[leaf],
                       best.right_sum_h[leaf], best.right_count[leaf])
         best = best.store(leaf, rl, can_go_deeper)
         best = best.store(R, rr, can_go_deeper)
@@ -563,7 +619,17 @@ def _grow_compact_impl(cfg: GrowConfig,
     state = lax.fori_loop(0, L - 1, step, state)
     row_leaf = _row_leaf_from_order(state.order, state.leaf_begin,
                                     state.leaf_count, n, L)
-    return state.tree, row_leaf
+    tree = state.tree
+    if quant and cfg.renew_leaf:
+        # RenewIntGradTreeOutput (gradient_discretizer.hpp): replace the
+        # quantized leaf outputs with exact float sums per leaf.
+        sg = psum(jax.ops.segment_sum(gw3[:, 0], row_leaf, num_segments=L))
+        sh = psum(jax.ops.segment_sum(gw3[:, 1], row_leaf, num_segments=L))
+        newv = leaf_output(sg, sh, p)
+        lv = jnp.where(jnp.arange(L) < tree.num_leaves, newv,
+                       tree.leaf_value)
+        tree = tree._replace(leaf_value=lv)
+    return tree, row_leaf
 
 
 grow_tree = jax.jit(grow_tree_impl, static_argnames=("cfg",))

@@ -136,7 +136,10 @@ def _extract_matrix(booster, data) -> np.ndarray:
 def predict_any(booster, data, start_iteration: int = 0,
                 num_iteration: int = -1, raw_score: bool = False,
                 pred_leaf: bool = False,
-                pred_contrib: bool = False) -> np.ndarray:
+                pred_contrib: bool = False,
+                pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0) -> np.ndarray:
     from .basic import LightGBMError
     X = _extract_matrix(booster, data)
     n_feat = booster.num_feature()
@@ -170,7 +173,19 @@ def predict_any(booster, data, start_iteration: int = 0,
         leaves = _predict_leaves_jit(stacked, Xd, len(sel))
         return np.asarray(leaves, np.int32)
 
-    scores = _predict_scores_jit(stacked, Xd, len(sel), K)
+    # the reference enables margin early-exit only when the objective
+    # tolerates inexact sums — classification, not regression
+    # (predictor.hpp:46 gates on !NeedAccuratePrediction())
+    obj_name = (booster._objective_str or "none").split()[0]
+    es_ok = obj_name in ("binary", "multiclass", "multiclassova",
+                         "softmax", "cross_entropy", "lambdarank",
+                         "rank_xendcg")
+    if pred_early_stop and es_ok and not booster._avg_output:
+        scores = _predict_scores_early_stop(
+            stacked, Xd, len(sel), K, max(1, pred_early_stop_freq),
+            pred_early_stop_margin)
+    else:
+        scores = _predict_scores_jit(stacked, Xd, len(sel), K)
     out = np.asarray(scores, np.float64)  # [n, K]
 
     if booster._avg_output:
@@ -206,12 +221,42 @@ def _predict_scores_jit(stacked, X, T, K):
     return scores.T  # [n, K]
 
 
+def _predict_scores_early_stop(stacked, X, T, K, freq, margin):
+    """Margin-based prediction early exit (prediction_early_stop.cpp):
+    every ``freq`` iterations a row whose margin exceeds the threshold is
+    frozen — binary margin = 2|score|, multiclass = top1 - top2. Rows are
+    processed in tree chunks; once every row is frozen remaining chunks
+    are skipped entirely."""
+    n = X.shape[0]
+    scores = jnp.zeros((n, K), stacked.leaf_value.dtype)
+    done = jnp.zeros((n,), bool)
+    chunk = freq * K
+    for lo in range(0, T, chunk):
+        hi = min(T, lo + chunk)
+        sub = jax.tree_util.tree_map(lambda a: a[lo:hi], stacked)
+        leaves = _forest_leaves(sub, X)                      # [t, n]
+        vals = jnp.take_along_axis(sub.leaf_value, leaves, axis=1)
+        delta = jnp.zeros((K, n), vals.dtype)
+        delta = delta.at[(jnp.arange(lo, hi)) % K].add(vals)
+        scores = scores + jnp.where(done[:, None], 0.0, delta.T)
+        if K == 1:
+            m = 2.0 * jnp.abs(scores[:, 0])
+        else:
+            top2 = lax.top_k(scores, 2)[0]
+            m = top2[:, 0] - top2[:, 1]
+        done = done | (m > margin)
+        if bool(jnp.all(done)):
+            break
+    return scores
+
+
 def _convert_output(booster, out: np.ndarray) -> np.ndarray:
     """Objective-specific output transform (ConvertOutput analog), driven
     by the objective string stored in the model header."""
     obj = (booster._objective_str or "none").split()
     name = obj[0] if obj else "none"
     kv = dict(t.split(":", 1) for t in obj[1:] if ":" in t)
+    flags = {t for t in obj[1:] if ":" not in t}
     if name == "binary":
         sig = float(kv.get("sigmoid", 1.0))
         return 1.0 / (1.0 + np.exp(-sig * out))
@@ -227,6 +272,6 @@ def _convert_output(booster, out: np.ndarray) -> np.ndarray:
         return 1.0 / (1.0 + np.exp(-out))
     if name == "cross_entropy_lambda":
         return np.log1p(np.exp(out))
-    if name in ("regression", "regression_l2") and "sqrt" in kv:
+    if name in ("regression", "regression_l2") and "sqrt" in flags:
         return np.sign(out) * out * out
     return out

@@ -103,6 +103,19 @@ class LambdarankNDCG(Objective):
         self.gain_of_row = jnp.asarray(gains_tbl[label.astype(np.int64)],
                                        jnp.float32)
         self._n = len(label)
+        # position-debiased LTR (rank_objective.hpp:43-56,297-334):
+        # factorize raw positions to ids; biases start at 0 and are
+        # Newton-updated from lambda/hessian sums each iteration.
+        pos = dataset.get_position() if hasattr(dataset, "get_position") \
+            else None
+        if pos is not None:
+            uniq, inverse = np.unique(np.asarray(pos), return_inverse=True)
+            self.position_ids = uniq
+            self.num_pos = int(len(uniq))
+            self.pos_ids = jnp.asarray(inverse.astype(np.int32))
+            self.pos_biases = jnp.zeros((self.num_pos,), jnp.float32)
+        else:
+            self.num_pos = 0
         # queries processed in blocks to bound the [blk, Q, Q] tensor
         qmax = idx.shape[1]
         target_elems = 1 << 25
@@ -110,8 +123,26 @@ class LambdarankNDCG(Objective):
                                target_elems // max(1, qmax * qmax)))
         self._ready = True
 
+    def _update_position_biases(self, g, h):
+        """Newton-Raphson step on per-position bias factors
+        (UpdatePositionBiasFactors, rank_objective.hpp:297-334)."""
+        reg = self.cfg.lambdarank_position_bias_regularization
+        lr = self.cfg.learning_rate
+        cnt = jax.ops.segment_sum(jnp.ones_like(g), self.pos_ids,
+                                  num_segments=self.num_pos)
+        fd = -jax.ops.segment_sum(g, self.pos_ids,
+                                  num_segments=self.num_pos) \
+            - self.pos_biases * reg * cnt
+        sd = -jax.ops.segment_sum(h, self.pos_ids,
+                                  num_segments=self.num_pos) - reg * cnt
+        self.pos_biases = self.pos_biases + lr * fd / (jnp.abs(sd) + 0.001)
+
     def grad_hess(self, score, label, weight):
         assert self._ready, "set_dataset must be called first"
+        if self.num_pos:
+            # lambdas computed against position-bias-adjusted scores
+            # (rank_objective.hpp:68-73 score_adjusted)
+            score = score + self.pos_biases[self.pos_ids]
         sigma = self.sigmoid
         trunc = self.trunc
         q_idx, q_mask = self.q_idx, self.q_mask
@@ -178,6 +209,11 @@ class LambdarankNDCG(Objective):
         if weight is not None:
             g = g * weight
             h = h * weight
+        # bias update sees the weighted lambdas, like the reference
+        # (weights are folded in inside the query loop before
+        # UpdatePositionBiasFactors runs, rank_objective.hpp:75-86)
+        if self.num_pos:
+            self._update_position_biases(g, h)
         return g, h
 
 

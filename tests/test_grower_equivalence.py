@@ -100,3 +100,19 @@ def test_compact_grower_weighted_rows():
     np.testing.assert_allclose(np.asarray(tm.leaf_value),
                                np.asarray(tc.leaf_value),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_hist_from_rows_int_exact():
+    """int8 nibble histogram is exact integer arithmetic."""
+    from lightgbm_tpu.ops.histogram import hist_from_rows_int
+    rs = np.random.RandomState(5)
+    S, F, B = 9000, 5, 130  # crosses ROW_BLOCK, s_hi=9
+    rows = rs.randint(0, B, size=(S, F)).astype(np.uint8)
+    pay = rs.randint(-4, 5, size=(S, 3)).astype(np.int8)
+    out = np.asarray(hist_from_rows_int(jnp.asarray(rows),
+                                        jnp.asarray(pay), B))
+    ref = np.zeros((F, B, 3), np.int64)
+    for f in range(F):
+        for c in range(3):
+            np.add.at(ref[f, :, c], rows[:, f], pay[:, c])
+    np.testing.assert_array_equal(out, ref)

@@ -1,0 +1,69 @@
+"""Position-debiased lambdarank + prediction early stop
+(rank_objective.hpp position bias; prediction_early_stop.cpp)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _ranking_data(n_query=60, per_q=12, f=8, seed=5):
+    rs = np.random.RandomState(seed)
+    n = n_query * per_q
+    X = rs.randn(n, f)
+    rel = X[:, 0] * 1.5 + 0.5 * rs.randn(n)
+    y = np.digitize(rel, np.quantile(rel, [0.5, 0.75, 0.9])).astype(float)
+    group = np.full(n_query, per_q)
+    return X, y, group
+
+
+def test_lambdarank_position_debias_trains():
+    X, y, group = _ranking_data()
+    n = len(y)
+    position = np.tile(np.arange(12), n // 12)
+    d = lgb.Dataset(X, label=y, group=group, position=position)
+    bst = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                     "eval_at": [5], "num_leaves": 15, "min_data_in_leaf": 5,
+                     "verbosity": -1,
+                     "lambdarank_position_bias_regularization": 0.1},
+                    d, num_boost_round=10, valid_sets=[d])
+    obj = bst._engine.objective
+    assert obj.num_pos == 12
+    biases = np.asarray(obj.pos_biases)
+    assert np.all(np.isfinite(biases)) and np.any(biases != 0.0)
+    p = bst.predict(X)
+    assert np.all(np.isfinite(p))
+
+
+def test_pred_early_stop_binary_matches_when_margin_large():
+    rs = np.random.RandomState(0)
+    X = rs.randn(1500, 6)
+    y = ((X @ rs.randn(6)) > 0).astype(float)
+    d = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, d, num_boost_round=30)
+    full = bst.predict(X, raw_score=True)
+    # huge margin -> no row freezes -> identical
+    es = bst.predict(X, raw_score=True, pred_early_stop=True,
+                     pred_early_stop_freq=5, pred_early_stop_margin=1e9)
+    np.testing.assert_allclose(full, es, rtol=1e-5, atol=1e-5)
+    # tiny margin -> rows freeze after the first chunk; scores differ but
+    # the sign (the decision) overwhelmingly agrees
+    es2 = bst.predict(X, raw_score=True, pred_early_stop=True,
+                      pred_early_stop_freq=5, pred_early_stop_margin=0.01)
+    agree = np.mean(np.sign(es2) == np.sign(full))
+    assert agree > 0.9
+
+
+def test_pred_early_stop_multiclass():
+    rs = np.random.RandomState(1)
+    X = rs.randn(900, 5)
+    y = np.argmax(X[:, :3] + 0.3 * rs.randn(900, 3), axis=1).astype(float)
+    d = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1}, d,
+                    num_boost_round=12)
+    full = bst.predict(X)
+    es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=3,
+                     pred_early_stop_margin=1e9)
+    np.testing.assert_allclose(full, es, rtol=1e-5, atol=1e-5)

@@ -111,3 +111,26 @@ def test_multiclass():
     np.testing.assert_allclose(pred.sum(axis=1), 1.0, rtol=1e-4)
     acc = np.mean(pred.argmax(axis=1) == y)
     assert acc > 0.85, acc
+
+
+def test_quantized_training_close_to_float():
+    """use_quantized_grad (GradientDiscretizer analog): int8 histograms
+    with stochastic rounding + leaf renewal track the float path
+    (reference test_engine.py quantized-training tolerance model)."""
+    import lightgbm_tpu as lgb
+
+    X, y = make_synthetic_binary(n=3000, f=10, seed=11)
+    base = {"objective": "binary", "metric": "auc", "num_leaves": 15,
+            "min_data_in_leaf": 20, "verbosity": -1, "seed": 3}
+    d1 = lgb.Dataset(X, label=y)
+    b_float = lgb.train(dict(base), d1, num_boost_round=20)
+    d2 = lgb.Dataset(X, label=y)
+    b_quant = lgb.train(dict(base, use_quantized_grad=True,
+                             num_grad_quant_bins=8,
+                             quant_train_renew_leaf=True), d2,
+                        num_boost_round=20)
+    from sklearn.metrics import roc_auc_score
+    auc_f = roc_auc_score(y, b_float.predict(X))
+    auc_q = roc_auc_score(y, b_quant.predict(X))
+    assert auc_q > 0.95 * auc_f
+    assert auc_q > 0.8
