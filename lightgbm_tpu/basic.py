@@ -400,6 +400,16 @@ class Dataset:
         cols = [X[:, j] for j in self._used_features]
         self._bins = bin_values(cols, self.mappers)
         self._F = len(self.mappers)
+        # linear trees fit on raw numerical values (the reference keeps
+        # raw data when linear_tree is set — Dataset raw_data_, dataset.h).
+        # Datasets aligned to a reference inherit its retention so valid
+        # sets of a linear model can be scored.
+        if cfg.linear_tree or (self.reference is not None
+                               and self.reference.raw_numeric() is not None):
+            self._raw_numeric = np.column_stack(cols).astype(np.float32) \
+                if cols else np.zeros((n, 0), np.float32)
+        else:
+            self._raw_numeric = None
 
         self.label = y
         self.weight = None if weight is None else \
@@ -460,6 +470,11 @@ class Dataset:
         (Metadata::positions, dataset.h:48-398)."""
         return self.position
 
+    def raw_numeric(self) -> Optional[np.ndarray]:
+        """[n, F_used] float32 raw values (NaN preserved) — retained only
+        when linear_tree is set (the reference's Dataset raw_data_)."""
+        return getattr(self, "_raw_numeric", None)
+
     def set_position(self, position) -> "Dataset":
         self.position = None if position is None else \
             np.asarray(position).ravel()
@@ -507,6 +522,19 @@ class Dataset:
     def host_bins(self) -> np.ndarray:
         self.construct()
         return self._bins
+
+    def device_raw(self):
+        """[n, F_used] raw float32 values on device (linear trees)."""
+        import jax.numpy as jnp
+        self.construct()
+        if getattr(self, "_device_raw", None) is None:
+            rn = self.raw_numeric()
+            if rn is None:
+                raise LightGBMError(
+                    "linear tree evaluation needs raw data; construct the "
+                    "Dataset with the linear_tree parameter")
+            self._device_raw = jnp.asarray(rn)
+        return self._device_raw
 
     def device_feat_num_bins(self):
         import jax.numpy as jnp
@@ -907,6 +935,11 @@ class Booster:
         order so later trees see refreshed scores)."""
         if not self._models:
             raise LightGBMError("Cannot refit an empty model")
+        if any(t.is_linear and t.leaf_coeff and any(
+                len(c) for c in t.leaf_coeff) for t in self._models):
+            raise LightGBMError(
+                "refit is not yet supported for linear trees (the "
+                "reference's is_refit CalculateLinear path)")
         new_bst = self.__deepcopy__(None)
         X = np.asarray(data, np.float64)
         y = np.asarray(label, np.float64).ravel()

@@ -331,6 +331,9 @@ def _subset_dataset(full: Dataset, idx: np.ndarray,
     sub._bins = full._bins[idx]
     sub._device_bins = None
     sub._n = len(idx)
+    rn = full.raw_numeric()
+    sub._raw_numeric = None if rn is None else rn[idx]
+    sub._device_raw = None
     sub.label = np.asarray(full.get_label())[idx]
     w = full.get_weight()
     sub.weight = None if w is None else np.asarray(w)[idx]

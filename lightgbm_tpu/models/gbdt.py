@@ -48,6 +48,22 @@ def _tree_values_binned(split_feature, threshold_bin, default_left,
     return leaf_value[leaves]
 
 
+@jax.jit
+def _tree_leaves_binned(split_feature, threshold_bin, default_left,
+                        left_child, right_child,
+                        feat_nan_bin, bins_T, is_cat=None, cat_masks=None):
+    return predict_leaf_binned(split_feature, threshold_bin, default_left,
+                               left_child, right_child, feat_nan_bin,
+                               bins_T, is_cat, cat_masks)
+
+
+@jax.jit
+def _linear_eval(const, coef, feats, nfeat, leaf_value, raw, leaves):
+    from ..ops.linear import linear_leaf_values
+    return linear_leaf_values(const, coef, feats, nfeat, leaf_value, raw,
+                              leaves)
+
+
 class _ValidData:
     def __init__(self, dataset, score: jnp.ndarray, name: str):
         self.dataset = dataset
@@ -82,6 +98,21 @@ class GBDTBooster:
         self.weight = None if w is None else jnp.asarray(w, jnp.float32)
         mono = ds.monotone_array(cfg)
         self.monotone = None if mono is None else jnp.asarray(mono, jnp.int8)
+
+        # linear trees (LinearTreeLearner): fit leaf-wise linear models on
+        # raw numerical values after growth
+        self.raw = None
+        if cfg.linear_tree:
+            if self.monotone is not None:
+                raise ValueError(
+                    "linear_tree does not support monotone constraints "
+                    "(reference config check)")
+            rn = ds.raw_numeric()
+            if rn is None:
+                raise ValueError(
+                    "linear_tree requires the Dataset to be constructed "
+                    "with the linear_tree parameter (raw data retained)")
+            self.raw = jnp.asarray(rn)
 
         # boost_from_average (gbdt.cpp:319). The average is folded into the
         # first iteration's trees as a leaf-value bias (TrainOneIter's
@@ -209,11 +240,10 @@ class GBDTBooster:
         ui = dataset.get_init_score()
         if ui is not None:
             score = score + jnp.asarray(ui, jnp.float32).reshape(self.K, nv)
-        bins_T = dataset.device_bins()
         for i, tree in enumerate(self.models):
             k = i % self.K
             score = score.at[k].add(self._predict_tree_binned_host(
-                tree, bins_T))
+                tree, dataset))
         if is_rf and self.iter_ > 0:
             # rf scores are the running average of unscaled tree outputs
             score = score / self.iter_
@@ -253,10 +283,13 @@ class GBDTBooster:
         return out
 
     def _predict_tree_binned_host(self, tree: Tree,
-                                  bins_T: jnp.ndarray) -> jnp.ndarray:
+                                  dataset) -> jnp.ndarray:
+        bins_T = dataset.device_bins()
         if tree.num_leaves <= 1:
-            return jnp.full((bins_T.shape[1],), float(tree.leaf_value[0]),
-                            jnp.float32)
+            base = float(tree.leaf_const[0]) if tree.is_linear \
+                and getattr(tree, "leaf_const", None) is not None \
+                else float(tree.leaf_value[0])
+            return jnp.full((bins_T.shape[1],), base, jnp.float32)
         # map real feature index back to inner (used-feature) index
         inner = self.train_set.inner_feature_index(tree.split_feature)
         tb, isc, cmask = self._binned_node_arrays(tree)
@@ -278,14 +311,116 @@ class GBDTBooster:
                         jnp.asarray(cm_pad))
         else:
             cat_args = (None, None)
-        return _tree_values_binned(
+        node_args = (
             jnp.asarray(pad(inner, nn, 0, np.int32)),
             jnp.asarray(pad(tb, nn, 0, np.int32)),
             jnp.asarray(pad((tree.decision_type & 2) != 0, nn, False, bool)),
             jnp.asarray(pad(tree.left_child, nn, -1, np.int32)),
-            jnp.asarray(pad(tree.right_child, nn, -1, np.int32)),
+            jnp.asarray(pad(tree.right_child, nn, -1, np.int32)))
+        if tree.is_linear and getattr(tree, "leaf_const", None) is not None:
+            leaves = _tree_leaves_binned(*node_args, self.feat_nan_bin,
+                                         bins_T, *cat_args)
+            return self._linear_values_binned(tree, dataset, leaves)
+        return _tree_values_binned(
+            *node_args,
             jnp.asarray(pad(tree.leaf_value, L, 0.0, np.float32)),
             self.feat_nan_bin, bins_T, *cat_args)
+
+    # ------------------------------------------------------------------
+    # linear leaves (LinearTreeLearner::CalculateLinear analog)
+    # ------------------------------------------------------------------
+    def _fit_linear(self, dev_tree, row_leaf, grad, hess, row_w,
+                    is_first: bool):
+        """Fit per-leaf linear models. Returns (const_dev, coeff_dev,
+        pred_dev, feats_inner: list, kmax)."""
+        from ..ops.linear import branch_features_per_leaf, fit_leaf_linear
+        from ..ops.binning import BinType
+        L = self.cfg.num_leaves
+        num_leaves = int(np.asarray(dev_tree.num_leaves))
+        mappers = self.train_set.mappers
+
+        def is_num(f):
+            return mappers[f].bin_type == BinType.NUMERICAL
+
+        if is_first or num_leaves <= 1:
+            # first iteration's trees stay constant
+            # (linear_tree_learner.cpp:185-190 is_first_tree path)
+            return (dev_tree.leaf_value, None,
+                    dev_tree.leaf_value[row_leaf], [[] for _ in range(L)], 0)
+        feats = branch_features_per_leaf(
+            np.asarray(dev_tree.split_feature),
+            np.asarray(dev_tree.left_child),
+            np.asarray(dev_tree.right_child),
+            np.asarray(dev_tree.leaf_parent), num_leaves, is_num)
+        feats += [[] for _ in range(L - num_leaves)]
+        kmax = max((len(f) for f in feats), default=0)
+        if kmax == 0:
+            return (dev_tree.leaf_value, None,
+                    dev_tree.leaf_value[row_leaf], feats, 0)
+        lf = np.zeros((L, kmax), np.int32)
+        nf = np.zeros((L,), np.int32)
+        for i, f in enumerate(feats):
+            lf[i, : len(f)] = f
+            nf[i] = len(f)
+        const, coeff, pred = fit_leaf_linear(
+            self.raw, row_leaf, grad, hess, row_w,
+            jnp.asarray(lf), jnp.asarray(nf), dev_tree.leaf_value,
+            self.cfg.linear_lambda)
+        return (const, coeff, pred, feats, kmax)
+
+    def _attach_linear(self, tree, lin, shrinkage: float) -> None:
+        """Move the device fit into the host Tree (real feature ids;
+        near-zero coefficients dropped like the kZeroThreshold filter)."""
+        const, coeff, _, feats, kmax = lin
+        used = self.train_set.used_feature_indices()
+        Lr = tree.num_leaves
+        tree.is_linear = True
+        tree.leaf_const = np.asarray(const, np.float64)[:Lr] * shrinkage
+        coeff_np = None if coeff is None else np.asarray(coeff, np.float64)
+        leaf_features, leaf_coeff = [], []
+        for i in range(Lr):
+            fs, cs = [], []
+            for j, f in enumerate(feats[i]):
+                c = 0.0 if coeff_np is None else coeff_np[i, j]
+                if abs(c) > 1e-35:
+                    fs.append(int(used[f]))
+                    cs.append(c * shrinkage)
+            leaf_features.append(fs)
+            leaf_coeff.append(cs)
+        tree.leaf_features = leaf_features
+        tree.leaf_coeff = leaf_coeff
+
+    def _linear_values_binned(self, tree, dataset, leaves):
+        """Per-row outputs of a linear tree over binned leaf assignment
+        (AddPredictionToScore's linear path, tree.cpp:120-150). Arrays
+        are padded to (cfg.num_leaves, pow2 feature count) so the jitted
+        evaluator compiles a handful of shapes, not one per tree."""
+        Lr = tree.num_leaves
+        L = max(self.cfg.num_leaves, Lr)
+        km = max((len(f) for f in tree.leaf_features), default=0)
+        const = np.zeros((L,), np.float64)
+        const[:Lr] = tree.leaf_const[:Lr]
+        if km == 0:
+            return jnp.asarray(const, jnp.float32)[leaves]
+        kp = 1
+        while kp < km:
+            kp *= 2
+        raw = dataset.device_raw()
+        lf = np.zeros((L, kp), np.int32)
+        nf = np.zeros((L,), np.int32)
+        cf = np.zeros((L, kp), np.float64)
+        lv = np.zeros((L,), np.float64)
+        lv[:Lr] = tree.leaf_value[:Lr]
+        for i in range(Lr):
+            inner = dataset.inner_feature_index(
+                np.asarray(tree.leaf_features[i], np.int32))
+            lf[i, : len(inner)] = inner
+            nf[i] = len(inner)
+            cf[i, : len(inner)] = tree.leaf_coeff[i]
+        return _linear_eval(
+            jnp.asarray(const, jnp.float32), jnp.asarray(cf, jnp.float32),
+            jnp.asarray(lf), jnp.asarray(nf),
+            jnp.asarray(lv, jnp.float32), raw, leaves)
 
     # ------------------------------------------------------------------
     # sampling strategies (bagging.hpp / goss.hpp analogs)
@@ -429,6 +564,12 @@ class GBDTBooster:
                 if it == 0 and (self._fold_bias or cfg.boosting == "rf"):
                     bias = float(self.init_score[k])
                 tree.leaf_value[:] = bias
+                if cfg.linear_tree:
+                    tree.is_linear = True
+                    tree.leaf_const = tree.leaf_value.copy()
+                    tree.leaf_features = [[] for _ in
+                                          range(tree.num_leaves)]
+                    tree.leaf_coeff = [[] for _ in range(tree.num_leaves)]
                 self.models.append(tree)
                 self._tree_weights.append(1.0)
                 if cfg.boosting == "rf":
@@ -462,9 +603,16 @@ class GBDTBooster:
                     self.objective.renew_alpha, leaf_values)
                 dev_tree = dev_tree._replace(leaf_value=leaf_values)
 
+            lin = None
+            if cfg.linear_tree:
+                lin = self._fit_linear(
+                    dev_tree, row_leaf, grad[k], hess[k], row_w,
+                    is_first=(len(self.models) < self.K))
             tree = tree_from_arrays(dev_tree, self.train_set.mappers,
                                     self.train_set.used_feature_indices())
             tree.apply_shrinkage(shrinkage)
+            if lin is not None:
+                self._attach_linear(tree, lin, shrinkage)
             fold_now = (cfg.boosting == "rf") or (it == 0 and self._fold_bias)
             if fold_now and self.init_score[k] != 0.0:
                 # Tree::AddBias: the constant rides inside leaf values so
@@ -472,33 +620,37 @@ class GBDTBooster:
                 tree.leaf_value = tree.leaf_value + self.init_score[k]
                 tree.internal_value = tree.internal_value \
                     + self.init_score[k]
+                if tree.is_linear and getattr(tree, "leaf_const",
+                                              None) is not None:
+                    # AddBias updates leaf_const too (tree.cpp:222-227)
+                    tree.leaf_const = tree.leaf_const + self.init_score[k]
             self.models.append(tree)
             self._tree_weights.append(1.0)
 
+            contrib_raw = lin[2] if lin is not None \
+                else leaf_values[row_leaf]
             if cfg.boosting == "rf":
                 # running average of unscaled tree outputs (rf.hpp
                 # MultiplyScore m -> UpdateScore -> MultiplyScore 1/(m+1))
-                contrib = leaf_values[row_leaf] + float(self.init_score[k])
+                contrib = contrib_raw + float(self.init_score[k])
                 self.score = self.score.at[k].set(
                     (self.score[k] * it + contrib) / (it + 1))
                 for v in self.valid_sets:
-                    dv = self._predict_tree_binned_host(
-                        tree, v.dataset.device_bins())
+                    dv = self._predict_tree_binned_host(tree, v.dataset)
                     v.score = v.score.at[k].set(
                         (v.score[k] * it + dv) / (it + 1))
             else:
                 # train-score update via the leaf partition — no
                 # re-traversal (ScoreUpdater::AddScore, score_updater.hpp)
                 self.score = self.score.at[k].add(
-                    leaf_values[row_leaf] * shrinkage)
+                    contrib_raw * shrinkage)
                 if it == 0 and self._fold_bias \
                         and self.init_score[k] != 0.0:
                     # internal score already starts at init; nothing to add
                     pass
                 for v in self.valid_sets:
                     v.score = v.score.at[k].add(
-                        self._predict_tree_binned_host(
-                            tree, v.dataset.device_bins()))
+                        self._predict_tree_binned_host(tree, v.dataset))
 
         if cfg.boosting == "dart" and drop_idx and grew_any:
             self._dart_normalize(drop_idx)
@@ -535,11 +687,10 @@ class GBDTBooster:
             k = i % self.K
             tree = self.models[i]
             self.score = self.score.at[k].add(
-                -self._predict_tree_binned_host(
-                    tree, self.train_set.device_bins()))
+                -self._predict_tree_binned_host(tree, self.train_set))
             for v in self.valid_sets:
                 v.score = v.score.at[k].add(-self._predict_tree_binned_host(
-                    tree, v.dataset.device_bins()))
+                    tree, v.dataset))
 
     def _dart_normalize(self, drop_idx: List[int]) -> None:
         """Shrink re-added dropped trees and the new tree (dart.hpp
@@ -557,11 +708,11 @@ class GBDTBooster:
             if self.models[i].num_leaves > 1:
                 k = i % self.K
                 delta = self._predict_tree_binned_host(self.models[i],
-                                                       self.train_set.device_bins())
+                                                       self.train_set)
                 self.score = self.score.at[k].add(delta * (new_w - 1.0))
                 for v in self.valid_sets:
                     dv = self._predict_tree_binned_host(
-                        self.models[i], v.dataset.device_bins())
+                        self.models[i], v.dataset)
                     v.score = v.score.at[k].add(dv * (new_w - 1.0))
                 self.models[i].apply_shrinkage(new_w)
         # scale the dropped trees and re-add
@@ -569,11 +720,11 @@ class GBDTBooster:
             k = i % self.K
             self.models[i].apply_shrinkage(old_factor)
             delta = self._predict_tree_binned_host(self.models[i],
-                                                   self.train_set.device_bins())
+                                                   self.train_set)
             self.score = self.score.at[k].add(delta)
             for v in self.valid_sets:
                 dv = self._predict_tree_binned_host(self.models[i],
-                                                    v.dataset.device_bins())
+                                                    v.dataset)
                 v.score = v.score.at[k].add(dv)
 
     # ------------------------------------------------------------------
@@ -588,7 +739,7 @@ class GBDTBooster:
             self._tree_weights.pop()
             if is_rf:
                 dv = self._predict_tree_binned_host(
-                    tree, self.train_set.device_bins())
+                    tree, self.train_set)
                 if m > 0:
                     self.score = self.score.at[k].set(
                         (self.score[k] * (m + 1) - dv) / m)
@@ -597,7 +748,7 @@ class GBDTBooster:
                         self.score[k], float(self.init_score[k])))
                 for v in self.valid_sets:
                     vv = self._predict_tree_binned_host(
-                        tree, v.dataset.device_bins())
+                        tree, v.dataset)
                     if m > 0:
                         v.score = v.score.at[k].set(
                             (v.score[k] * (m + 1) - vv) / m)
@@ -607,7 +758,7 @@ class GBDTBooster:
                 continue
             if tree.num_leaves > 1 or tree.leaf_value[0] != 0.0:
                 delta = self._predict_tree_binned_host(
-                    tree, self.train_set.device_bins())
+                    tree, self.train_set)
                 self.score = self.score.at[k].add(-delta)
                 if m == 0 and self._fold_bias:
                     # the popped iter-0 tree carried the folded bias, but
@@ -616,7 +767,7 @@ class GBDTBooster:
                         float(self.init_score[k]))
                 for v in self.valid_sets:
                     dv = self._predict_tree_binned_host(
-                        tree, v.dataset.device_bins())
+                        tree, v.dataset)
                     v.score = v.score.at[k].add(-dv)
         self.iter_ -= 1
 

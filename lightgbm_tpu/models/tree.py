@@ -52,7 +52,11 @@ class Tree:
     num_cat: int = 0
     cat_boundaries: Optional[np.ndarray] = None
     cat_threshold: Optional[np.ndarray] = None
+    # linear leaves (tree.h leaf_const_/leaf_coeff_/leaf_features_)
     is_linear: bool = False
+    leaf_const: Optional[np.ndarray] = None      # [L] f64
+    leaf_features: Optional[list] = None         # per-leaf real feature ids
+    leaf_coeff: Optional[list] = None            # per-leaf coefficients
 
     @property
     def num_nodes(self) -> int:
@@ -68,10 +72,15 @@ class Tree:
         return (int(self.decision_type[i]) >> 2) & 3
 
     def apply_shrinkage(self, rate: float) -> None:
-        """Tree::Shrinkage (tree.h:188)."""
+        """Tree::Shrinkage (tree.h:188; scales linear leaves too,
+        tree.h:192-206)."""
         self.leaf_value = self.leaf_value * rate
         self.internal_value = self.internal_value * rate
         self.shrinkage *= rate
+        if self.is_linear and self.leaf_const is not None:
+            self.leaf_const = self.leaf_const * rate
+            self.leaf_coeff = [[c * rate for c in cs]
+                               for cs in (self.leaf_coeff or [])]
 
     def num_leaves_actual(self) -> int:
         return self.num_leaves
@@ -79,6 +88,15 @@ class Tree:
     # -- single-row host predict (reference: tree.h:134) ------------------
     def predict_row(self, x: np.ndarray) -> float:
         leaf = self.predict_leaf_row(x)
+        if self.is_linear and self.leaf_const is not None:
+            out = float(self.leaf_const[leaf])
+            feats = self.leaf_features[leaf] if self.leaf_features else []
+            for f, c in zip(feats, self.leaf_coeff[leaf]):
+                v = x[f]
+                if np.isnan(v):
+                    return float(self.leaf_value[leaf])
+                out += c * v
+            return out
         return float(self.leaf_value[leaf])
 
     def predict_leaf_row(self, x: np.ndarray) -> int:
@@ -151,8 +169,21 @@ class Tree:
                 ]
         else:
             lines += ["leaf_value=" + fmt(self.leaf_value[:1], "%.17g")]
-        lines += [f"is_linear={int(self.is_linear)}",
-                  f"shrinkage={self.shrinkage:g}"]
+        lines += [f"is_linear={int(self.is_linear)}"]
+        if self.is_linear and self.leaf_const is not None:
+            L = self.num_leaves
+            nf = [len(self.leaf_features[i]) if self.leaf_features else 0
+                  for i in range(L)]
+            lines += ["leaf_const=" + fmt(self.leaf_const[:L], "%.17g"),
+                      "num_features=" + fmt(nf, "%d")]
+            feat_toks, coef_toks = [], []
+            for i in range(L):
+                if nf[i]:
+                    feat_toks += ["%d" % f for f in self.leaf_features[i]]
+                    coef_toks += ["%.17g" % c for c in self.leaf_coeff[i]]
+            lines += ["leaf_features=" + " ".join(feat_toks),
+                      "leaf_coeff=" + " ".join(coef_toks)]
+        lines += [f"shrinkage={self.shrinkage:g}"]
         return "\n".join(lines) + "\n\n"
 
     @classmethod
@@ -191,6 +222,21 @@ class Tree:
                                           np.int64)
             t.cat_threshold = np.asarray(kv["cat_threshold"].split(),
                                          np.uint32)
+        if t.is_linear and "leaf_const" in kv:
+            t.leaf_const = np.asarray(kv["leaf_const"].split(), np.float64)
+            nf = np.asarray(kv.get("num_features", "").split() or [0] * L,
+                            np.int64)
+            feat_toks = kv.get("leaf_features", "").split()
+            coef_toks = kv.get("leaf_coeff", "").split()
+            t.leaf_features, t.leaf_coeff = [], []
+            pos = 0
+            for i in range(L):
+                k = int(nf[i]) if i < len(nf) else 0
+                t.leaf_features.append(
+                    [int(v) for v in feat_toks[pos: pos + k]])
+                t.leaf_coeff.append(
+                    [float(v) for v in coef_toks[pos: pos + k]])
+                pos += k
         return t
 
 

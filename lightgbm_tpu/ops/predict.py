@@ -45,6 +45,11 @@ class StackedTrees(NamedTuple):
     left_child: jnp.ndarray      # [T, L-1] i32
     right_child: jnp.ndarray     # [T, L-1] i32
     leaf_value: jnp.ndarray      # [T, L] f32
+    # linear leaves (None for constant-leaf forests)
+    lin_const: jnp.ndarray = None   # [T, L] f32
+    lin_nfeat: jnp.ndarray = None   # [T, L] i32
+    lin_feats: jnp.ndarray = None   # [T, L, km] i32 (real feature ids)
+    lin_coef: jnp.ndarray = None    # [T, L, km] f32
 
 
 def _traverse(n: int, decide_fn, left_child, right_child):

@@ -72,6 +72,35 @@ def stack_trees(trees: List[Tree], dtype=jnp.float32) -> StackedTrees:
             lc[i, 0] = -1
             rc[i, 0] = -1
         lv[i, : t.num_leaves] = t.leaf_value
+    any_linear = any(t.is_linear and t.leaf_const is not None
+                     for t in trees)
+    lin_args = {}
+    if any_linear:
+        km = max((len(fs) for t in trees if t.leaf_features
+                  for fs in t.leaf_features), default=0)
+        km = max(km, 1)
+        lconst = np.zeros((T, max_leaves), np.float64)
+        lnf = np.zeros((T, max_leaves), np.int32)
+        lfe = np.zeros((T, max_leaves, km), np.int32)
+        lco = np.zeros((T, max_leaves, km), np.float64)
+        for i, t in enumerate(trees):
+            if t.is_linear and t.leaf_const is not None:
+                Lr = t.num_leaves
+                lconst[i, :Lr] = t.leaf_const[:Lr]
+                for leaf in range(Lr):
+                    fs = t.leaf_features[leaf] if t.leaf_features else []
+                    lnf[i, leaf] = len(fs)
+                    lfe[i, leaf, : len(fs)] = fs
+                    lco[i, leaf, : len(fs)] = t.leaf_coeff[leaf]
+            else:
+                # constant tree inside a linear forest: emulate with a
+                # zero-feature linear model
+                lconst[i, : t.num_leaves] = t.leaf_value
+        lin_args = dict(lin_const=jnp.asarray(lconst, dtype),
+                        lin_nfeat=jnp.asarray(lnf),
+                        lin_feats=jnp.asarray(lfe),
+                        lin_coef=jnp.asarray(lco, dtype))
+
     # f32-safe thresholds: round DOWN to the nearest f32 so that any
     # f32-representable feature value keeps its training-time side of the
     # split (thresholds are f64 midpoints between adjacent values; plain
@@ -92,6 +121,7 @@ def stack_trees(trees: List[Tree], dtype=jnp.float32) -> StackedTrees:
         left_child=jnp.asarray(lc),
         right_child=jnp.asarray(rc),
         leaf_value=jnp.asarray(lv, dtype),
+        **lin_args,
     )
 
 
@@ -159,6 +189,10 @@ def predict_any(booster, data, start_iteration: int = 0,
     n = X.shape[0]
 
     if pred_contrib:
+        if any(t.is_linear and t.leaf_coeff and any(
+                len(c) for c in t.leaf_coeff) for t in sel):
+            raise LightGBMError(
+                "pred_contrib (SHAP) is not supported for linear trees")
         from .shap import predict_contrib
         return predict_contrib(booster, X, sel, K)
 
@@ -212,13 +246,33 @@ def _predict_leaves_jit(stacked, X, T):
 
 def _predict_scores_jit(stacked, X, T, K):
     leaves = _forest_leaves(stacked, X)  # [T, n]
-    vals = jnp.take_along_axis(stacked.leaf_value, leaves, axis=1)  # [T, n]
+    if stacked.lin_const is not None:
+        vals = _linear_forest_values(stacked, X, leaves)
+    else:
+        vals = jnp.take_along_axis(stacked.leaf_value, leaves, axis=1)
     n = X.shape[0]
     # tree i contributes to class i % K
     scores = jnp.zeros((K, n), vals.dtype)
     class_of_tree = jnp.arange(T) % K
     scores = scores.at[class_of_tree].add(vals)
     return scores.T  # [n, K]
+
+
+@jax.jit
+def _linear_forest_values(stacked: StackedTrees, X: jnp.ndarray,
+                          leaves: jnp.ndarray) -> jnp.ndarray:
+    """Per-tree linear-leaf outputs (shared evaluator vmapped over
+    trees)."""
+    from .ops.linear import linear_leaf_values
+
+    def per_tree(ti):
+        return linear_leaf_values(
+            stacked.lin_const[ti], stacked.lin_coef[ti],
+            stacked.lin_feats[ti], stacked.lin_nfeat[ti],
+            stacked.leaf_value[ti], X, leaves[ti])
+
+    T = stacked.leaf_value.shape[0]
+    return jax.vmap(per_tree)(jnp.arange(T))
 
 
 def _predict_scores_early_stop(stacked, X, T, K, freq, margin):
@@ -235,7 +289,10 @@ def _predict_scores_early_stop(stacked, X, T, K, freq, margin):
         hi = min(T, lo + chunk)
         sub = jax.tree_util.tree_map(lambda a: a[lo:hi], stacked)
         leaves = _forest_leaves(sub, X)                      # [t, n]
-        vals = jnp.take_along_axis(sub.leaf_value, leaves, axis=1)
+        if sub.lin_const is not None:
+            vals = _linear_forest_values(sub, X, leaves)
+        else:
+            vals = jnp.take_along_axis(sub.leaf_value, leaves, axis=1)
         delta = jnp.zeros((K, n), vals.dtype)
         delta = delta.at[(jnp.arange(lo, hi)) % K].add(vals)
         scores = scores + jnp.where(done[:, None], 0.0, delta.T)

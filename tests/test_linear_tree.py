@@ -1,0 +1,88 @@
+"""Linear trees (LinearTreeLearner, linear_tree_learner.cpp)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _linear_data(n=2000, f=5, seed=3, with_nan=False):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    y = 2.0 * X[:, 0] - 1.5 * X[:, 1] + 0.3 * X[:, 2] + 0.05 * rs.randn(n)
+    if with_nan:
+        X[rs.rand(n) < 0.05, 0] = np.nan
+    return X, y
+
+
+def test_linear_tree_beats_constant_on_linear_data():
+    X, y = _linear_data()
+    params = {"objective": "regression", "num_leaves": 4,
+              "min_data_in_leaf": 20, "learning_rate": 0.5,
+              "verbosity": -1}
+    d1 = lgb.Dataset(X, label=y, params={"linear_tree": True})
+    b_lin = lgb.train(dict(params, linear_tree=True), d1,
+                      num_boost_round=10)
+    d2 = lgb.Dataset(X, label=y)
+    b_const = lgb.train(dict(params), d2, num_boost_round=10)
+    mse_lin = float(np.mean((b_lin.predict(X) - y) ** 2))
+    mse_const = float(np.mean((b_const.predict(X) - y) ** 2))
+    assert mse_lin < 0.5 * mse_const
+    # trained trees carry linear models
+    assert any(t.is_linear and any(len(c) for c in (t.leaf_coeff or []))
+               for t in b_lin._models)
+
+
+def test_linear_tree_save_load_roundtrip(tmp_path):
+    X, y = _linear_data(seed=7)
+    d = lgb.Dataset(X, label=y, params={"linear_tree": True})
+    bst = lgb.train({"objective": "regression", "num_leaves": 5,
+                     "linear_tree": True, "verbosity": -1}, d,
+                    num_boost_round=8)
+    p1 = bst.predict(X)
+    path = str(tmp_path / "lin.txt")
+    bst.save_model(path)
+    b2 = lgb.Booster(model_file=path)
+    p2 = b2.predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+    assert "is_linear=1" in open(path).read()
+
+
+def test_linear_tree_nan_falls_back_to_constant():
+    X, y = _linear_data(with_nan=True)
+    d = lgb.Dataset(X, label=y, params={"linear_tree": True})
+    bst = lgb.train({"objective": "regression", "num_leaves": 5,
+                     "linear_tree": True, "verbosity": -1}, d,
+                    num_boost_round=5)
+    p = bst.predict(X)
+    assert np.all(np.isfinite(p))
+    # train metric consistency: internal score equals re-predicted score
+    internal = bst._engine.current_score(0)[0]
+    np.testing.assert_allclose(internal, bst.predict(X), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_linear_tree_with_valid_sets_and_cv():
+    """Valid Datasets built with reference= inherit raw retention; cv
+    folds subset the raw matrix (review findings on reference-aligned
+    datasets)."""
+    X, y = _linear_data(n=600, seed=9)
+    d = lgb.Dataset(X[:500], label=y[:500], params={"linear_tree": True})
+    v = lgb.Dataset(X[500:], label=y[500:], reference=d)
+    ev = {}
+    bst = lgb.train({"objective": "regression", "num_leaves": 4,
+                     "linear_tree": True, "metric": "l2",
+                     "verbosity": -1}, d, num_boost_round=5,
+                    valid_sets=[v],
+                    callbacks=[lgb.record_evaluation(ev)])
+    assert len(ev["valid_0"]["l2"]) == 5
+    # valid score equals re-predicted score
+    internal = bst._engine.current_score(1)[0]
+    np.testing.assert_allclose(internal, bst.predict(X[500:],
+                                                     raw_score=True),
+                               rtol=1e-4, atol=1e-4)
+    res = lgb.cv({"objective": "regression", "num_leaves": 4,
+                  "linear_tree": True, "metric": "l2", "verbosity": -1},
+                 lgb.Dataset(X, label=y, params={"linear_tree": True}),
+                 num_boost_round=3, nfold=3)
+    assert len(res["valid l2-mean"]) == 3
