@@ -98,6 +98,9 @@ class GBDTBooster:
         self.weight = None if w is None else jnp.asarray(w, jnp.float32)
         mono = ds.monotone_array(cfg)
         self.monotone = None if mono is None else jnp.asarray(mono, jnp.int8)
+        self.interaction_groups = self._parse_interaction_constraints(cfg)
+        self.forced = self._load_forced_splits(cfg)
+        self._init_cegb(cfg)
 
         # linear trees (LinearTreeLearner): fit leaf-wise linear models on
         # raw numerical values after growth
@@ -156,6 +159,10 @@ class GBDTBooster:
         grower = cfg.grower
         if cfg.use_quantized_grad and grower != "compact":
             grower = "compact"  # quantized histograms are compact-only
+        if self.interaction_groups is not None or self.forced is not None \
+                or self.cegb_enabled:
+            grower = "compact"  # per-leaf masks / forced splits need it
+        self.grow_cfg_extra = {}
         self.grow_cfg = GrowConfig(
             num_leaves=cfg.num_leaves,
             num_bins=ds.num_total_bins(),
@@ -166,6 +173,10 @@ class GBDTBooster:
             quant_bins=cfg.num_grad_quant_bins,
             renew_leaf=cfg.quant_train_renew_leaf,
             stochastic=cfg.stochastic_rounding,
+            cegb=self.cegb_enabled,
+            cegb_lazy=self.cegb_lazy,
+            cegb_tradeoff=cfg.cegb_tradeoff,
+            cegb_split=cfg.cegb_penalty_split,
             split=SplitParams(
                 lambda_l1=cfg.lambda_l1,
                 lambda_l2=cfg.lambda_l2,
@@ -189,6 +200,9 @@ class GBDTBooster:
         ndev = len(jax.devices())
         want_dp = (cfg.tree_learner in ("data", "feature", "voting")
                    or cfg.num_devices > 1)
+        if want_dp and ndev > 1 and self.cegb_enabled:
+            raise ValueError("CEGB is not supported with multi-device "
+                             "training yet")
         if want_dp and ndev > 1:
             from ..parallel.data_parallel import make_dp_grow_fn
             from ..parallel.mesh import make_mesh, pad_rows
@@ -201,7 +215,9 @@ class GBDTBooster:
             self._grow_fn = make_dp_grow_fn(
                 self.grow_cfg, self.mesh, self.monotone is not None,
                 self.feat_is_cat is not None,
-                cfg.use_quantized_grad and cfg.stochastic_rounding)
+                cfg.use_quantized_grad and cfg.stochastic_rounding,
+                self.interaction_groups is not None,
+                self.forced is not None)
 
         seed = cfg.seed if cfg.seed is not None else 0
         self._base_key = jax.random.PRNGKey(seed)
@@ -325,6 +341,106 @@ class GBDTBooster:
             *node_args,
             jnp.asarray(pad(tree.leaf_value, L, 0.0, np.float32)),
             self.feat_nan_bin, bins_T, *cat_args)
+
+    def _init_cegb(self, cfg) -> None:
+        """CEGB state (cost_effective_gradient_boosting.hpp IsEnable):
+        model-level feature-use flags and per-(row, feature) acquisition
+        bits persist across trees."""
+        enabled = (cfg.cegb_tradeoff < 1.0 or cfg.cegb_penalty_split > 0.0
+                   or len(cfg.cegb_penalty_feature_coupled) > 0
+                   or len(cfg.cegb_penalty_feature_lazy) > 0)
+        self.cegb_enabled = enabled
+        self.cegb_lazy = len(cfg.cegb_penalty_feature_lazy) > 0
+        if not enabled:
+            return
+        used = self.train_set.used_feature_indices()
+
+        def per_feature(lst):
+            out = np.zeros((self.F,), np.float32)
+            for i, r in enumerate(used):
+                if int(r) < len(lst):
+                    out[i] = lst[int(r)]
+            return jnp.asarray(out)
+
+        self._cegb_pen_coupled = per_feature(
+            cfg.cegb_penalty_feature_coupled)
+        self._cegb_pen_lazy = per_feature(cfg.cegb_penalty_feature_lazy)
+        self._cegb_coupled = jnp.zeros((self.F,), jnp.bool_)
+        self._cegb_lazy_used = (
+            jnp.zeros((self.n, self.F), jnp.bool_) if self.cegb_lazy
+            else None)
+
+    def _load_forced_splits(self, cfg) -> Optional[tuple]:
+        """forcedsplits_filename JSON -> BFS-ordered (leaf_slot, feature,
+        bin) arrays (ForceSplits, serial_tree_learner.cpp:620). Leaf slots
+        are precomputable because forced splits run first and in order:
+        the split at sequence index i sends its right child to slot
+        i + 1."""
+        fn = cfg.forcedsplits_filename
+        if not fn:
+            return None
+        import json as _json
+        from collections import deque
+        with open(fn) as fh:
+            root = _json.load(fh)
+        if not root:
+            return None
+        used = self.train_set.used_feature_indices()
+        inner_of = {int(r): i for i, r in enumerate(used)}
+        from ..ops.binning import BinType
+        leafs, feats, bins_ = [], [], []
+        q = deque([(root, 0)])
+        while q:
+            node, slot = q.popleft()
+            real = int(node["feature"])
+            inner = inner_of.get(real)
+            if inner is None or \
+                    self.train_set.mappers[inner].bin_type != \
+                    BinType.NUMERICAL:
+                import warnings
+                warnings.warn(
+                    f"forced split on unusable/categorical feature {real} "
+                    "ignored (with its subtree)")
+                continue
+            thr = float(node["threshold"])
+            t = int(self.train_set.mappers[inner].value_to_bin(
+                np.asarray([thr]))[0])
+            leafs.append(slot)
+            feats.append(inner)
+            bins_.append(t)
+            right_slot = len(leafs)
+            if node.get("left"):
+                q.append((node["left"], slot))
+            if node.get("right"):
+                q.append((node["right"], right_slot))
+        if not leafs:
+            return None
+        return (jnp.asarray(leafs, jnp.int32),
+                jnp.asarray(feats, jnp.int32),
+                jnp.asarray(bins_, jnp.int32))
+
+    def _parse_interaction_constraints(self, cfg) -> Optional[jnp.ndarray]:
+        """interaction_constraints -> [G, F_used] bool group masks
+        (config.h interaction_constraints; features outside every group
+        are unusable, col_sampler.hpp)."""
+        ic = cfg.interaction_constraints
+        if ic is None or ic == "" or ic == []:
+            return None
+        if isinstance(ic, str):
+            import ast
+            ic = list(ast.literal_eval(ic if ic.startswith("[[")
+                                       else "[" + ic + "]"))
+        names = list(getattr(self.train_set, "_feature_names", []) or [])
+        used = self.train_set.used_feature_indices()
+        inner_of = {int(r): i for i, r in enumerate(used)}
+        G = np.zeros((len(ic), self.F), bool)
+        for gi, grp in enumerate(ic):
+            for item in grp:
+                real = names.index(item) if isinstance(item, str) \
+                    else int(item)
+                if real in inner_of:
+                    G[gi, inner_of[real]] = True
+        return jnp.asarray(G)
 
     # ------------------------------------------------------------------
     # linear leaves (LinearTreeLearner::CalculateLinear analog)
@@ -544,15 +660,32 @@ class GBDTBooster:
                     args = args + (self.feat_is_cat,)
                 if quant_key is not None:
                     args = args + (jax.random.fold_in(quant_key, k),)
+                if self.interaction_groups is not None:
+                    args = args + (self.interaction_groups,)
+                if self.forced is not None:
+                    args = args + self.forced
                 dev_tree, row_leaf = self._grow_fn(*args)
                 row_leaf = row_leaf[: self.n]
             else:
-                dev_tree, row_leaf = grow_tree(
+                cegb_arrays = None
+                if self.cegb_enabled:
+                    cegb_arrays = (self._cegb_pen_coupled,
+                                   self._cegb_pen_lazy,
+                                   self._cegb_coupled,
+                                   self._cegb_lazy_used)
+                out = grow_tree(
                     self.grow_cfg, self.bins_T, grad[k], hess[k], row_w,
                     fmask, self.feat_num_bins, self.feat_nan_bin,
                     self.monotone, self.feat_is_cat,
                     None if quant_key is None
-                    else jax.random.fold_in(quant_key, k))
+                    else jax.random.fold_in(quant_key, k),
+                    self.interaction_groups, self.forced, cegb_arrays)
+                if self.cegb_enabled:
+                    dev_tree, row_leaf, self._cegb_coupled, lz = out
+                    if self.cegb_lazy:
+                        self._cegb_lazy_used = lz
+                else:
+                    dev_tree, row_leaf = out
             num_leaves = int(np.asarray(dev_tree.num_leaves))
             if num_leaves <= 1:
                 # constant tree; carries the boost_from_average bias when

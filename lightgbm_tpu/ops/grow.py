@@ -32,7 +32,8 @@ from jax import lax
 
 from .histogram import (build_histogram, hist_from_rows,
                         hist_from_rows_int, subtract_histogram)
-from .split import SplitParams, SplitResult, find_best_split, leaf_output
+from .split import (SplitParams, SplitResult, find_best_split, leaf_gain,
+                    leaf_output)
 
 __all__ = ["GrowConfig", "TreeArrays", "grow_tree"]
 
@@ -69,6 +70,12 @@ class GrowConfig(NamedTuple):
     quant_bins: int = 4          # num_grad_quant_bins
     renew_leaf: bool = False     # quant_train_renew_leaf
     stochastic: bool = True      # stochastic_rounding
+    # CEGB (cost_effective_gradient_boosting.hpp): gain penalties for
+    # splits / first feature use / per-row feature acquisition
+    cegb: bool = False
+    cegb_lazy: bool = False
+    cegb_tradeoff: float = 1.0
+    cegb_split: float = 0.0
 
 
 class TreeArrays(NamedTuple):
@@ -235,8 +242,12 @@ def grow_tree_impl(cfg: GrowConfig,
                    feat_nan_bin: jnp.ndarray,
                    monotone_constraints: Optional[jnp.ndarray] = None,
                    feat_is_cat: Optional[jnp.ndarray] = None,
-                   quant_key: Optional[jnp.ndarray] = None):
-    """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf).
+                   quant_key: Optional[jnp.ndarray] = None,
+                   interaction_groups: Optional[jnp.ndarray] = None,
+                   forced: Optional[tuple] = None,
+                   cegb_arrays: Optional[tuple] = None):
+    """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf)
+    (+ (coupled_used, lazy_used) when cfg.cegb).
 
     Args:
       bins_T: [F, n] uint8/uint16 bin matrix.
@@ -246,12 +257,23 @@ def grow_tree_impl(cfg: GrowConfig,
       feat_num_bins / feat_nan_bin: [F] i32 per-feature bin metadata.
       quant_key: PRNG key for stochastic gradient rounding (quantized
         mode only).
+      interaction_groups: optional [G, F] bool — allowed feature groups
+        (interaction_constraints); compact grower only.
+      forced: optional (leaf [M], feature [M], bin [M]) i32 arrays — the
+        pre-planned forced splits (forcedsplits_filename, BFS order);
+        compact grower only.
     """
     if cfg.grower == "compact":
         return _grow_compact_impl(cfg, bins_T, grad, hess, row_weight,
                                   feature_mask, feat_num_bins, feat_nan_bin,
                                   monotone_constraints, feat_is_cat,
-                                  quant_key)
+                                  quant_key, interaction_groups, forced,
+                                  cegb_arrays)
+    if interaction_groups is not None or forced is not None \
+            or cegb_arrays is not None:
+        raise NotImplementedError(
+            "interaction_constraints/forced splits/CEGB require the "
+            "compact grower")
     return _grow_masked_impl(cfg, bins_T, grad, hess, row_weight,
                              feature_mask, feat_num_bins, feat_nan_bin,
                              monotone_constraints, feat_is_cat)
@@ -392,7 +414,10 @@ class _CompactState(NamedTuple):
     order: jnp.ndarray       # [n] i32 — row ids grouped by leaf
     leaf_begin: jnp.ndarray  # [L] i32 (local raw offsets)
     leaf_count: jnp.ndarray  # [L] i32 (local raw counts)
+    branch: jnp.ndarray      # [L, F] bool — features used on leaf's path
     num_splits: jnp.ndarray  # scalar i32
+    cegb: tuple = ()         # (coupled_used [F], lazy_used [n,F],
+                             #  lazy_nu [L,F]) when cfg.cegb
 
 
 def _row_leaf_from_order(order, leaf_begin, leaf_count, n, L):
@@ -421,7 +446,10 @@ def _grow_compact_impl(cfg: GrowConfig,
                        feat_nan_bin: jnp.ndarray,
                        monotone_constraints: Optional[jnp.ndarray] = None,
                        feat_is_cat: Optional[jnp.ndarray] = None,
-                       quant_key: Optional[jnp.ndarray] = None):
+                       quant_key: Optional[jnp.ndarray] = None,
+                       interaction_groups: Optional[jnp.ndarray] = None,
+                       forced: Optional[tuple] = None,
+                       cegb_arrays: Optional[tuple] = None):
     """Leaf-wise growth with rows kept grouped by leaf.
 
     The reference's DataPartition (data_partition.hpp) + CUDA partition
@@ -442,10 +470,38 @@ def _grow_compact_impl(cfg: GrowConfig,
     def psum(x):
         return lax.psum(x, cfg.axis_name) if cfg.axis_name else x
 
-    def best_for(hist, sg, sh, sc):
+    def best_for(hist, sg, sh, sc, extra_mask=None, gain_penalty=None):
+        fmask = feature_mask if extra_mask is None \
+            else feature_mask & extra_mask
         return find_best_split(hist, sg, sh, sc, feat_num_bins, feat_nan_bin,
-                               feature_mask, p, monotone_constraints,
-                               feat_is_cat)
+                               fmask, p, monotone_constraints,
+                               feat_is_cat, gain_penalty)
+
+    def allowed_features(branch_set):
+        """Features usable at a node whose path used ``branch_set``
+        (ColSampler::GetByNode, col_sampler.hpp:205): union of the
+        constraint groups that contain the whole branch set."""
+        contains = ~jnp.any(branch_set[None, :] & ~interaction_groups,
+                            axis=1)                       # [G]
+        return jnp.any(interaction_groups & contains[:, None], axis=0)
+
+    cegb = cfg.cegb
+    cegb_lazy = cfg.cegb_lazy and cegb
+    if cegb:
+        pen_coupled, pen_lazy, coupled_used0, lazy_used0 = cegb_arrays
+        if cegb_lazy and lazy_used0 is None:
+            raise ValueError("cegb_lazy requires a lazy_used matrix")
+
+        def cegb_penalty(cnt, coupled_used, lazy_nu_leaf):
+            """DeltaGain (cost_effective_gradient_boosting.hpp:81-97):
+            tradeoff * (penalty_split*n + coupled-first-use + lazy)."""
+            pen = jnp.full((F,), cfg.cegb_tradeoff * cfg.cegb_split
+                           * 1.0, dtype) * cnt.astype(dtype)
+            pen = pen + jnp.where(coupled_used, 0.0,
+                                  cfg.cegb_tradeoff * pen_coupled)
+            if cegb_lazy:
+                pen = pen + cfg.cegb_tradeoff * pen_lazy * lazy_nu_leaf
+            return pen
 
     bins_rm = bins_T.T                      # [n, F] row-major for gathers
     w = row_weight.astype(dtype)
@@ -490,7 +546,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         return jnp.clip(jnp.sum(size > sizes_arr), 0, len(sizes) - 1)
 
     def make_part(S):
-        def br(order, start, cnt, f, t, dl, isc, cm):
+        def br(order, start, cnt, f, t, dl, isc, cm, lazy_used):
             start_c = jnp.clip(start, 0, n - S)
             rel = start - start_c
             idx = lax.dynamic_slice(order, (start_c,), (S,))
@@ -509,23 +565,33 @@ def _grow_compact_impl(cfg: GrowConfig,
             perm = jnp.argsort(key, stable=True)
             order2 = lax.dynamic_update_slice(order, idx[perm], (start_c,))
             n_left = jnp.sum((inp & gl).astype(jnp.int32))
-            return order2, n_left
+            if cegb_lazy:
+                # the split acquires feature f for every row in the leaf
+                # (UpdateLeafBestSplits' InsertBitset loop)
+                lazy_used = lazy_used.at[idx, f].max(inp)
+            return order2, n_left, lazy_used
         return br
 
     def make_hist(S):
-        def br(order, start, cnt):
+        def br(order, start, cnt, lazy_used):
             start_c = jnp.clip(start, 0, n - S)
             rel = start - start_c
             idx = lax.dynamic_slice(order, (start_c,), (S,))
             pos = jnp.arange(S)
             inp = (pos >= rel) & (pos < rel + cnt)
             rows = jnp.take(bins_rm, idx, axis=0)
+            if cegb_lazy:
+                used_rows = jnp.take(lazy_used, idx, axis=0)  # [S, F]
+                nu = jnp.sum(inp[:, None] & ~used_rows,
+                             axis=0).astype(dtype)
+            else:
+                nu = jnp.zeros((F,), dtype)
             if quant:
                 pay = jnp.take(gw3_q, idx, axis=0) \
                     * inp[:, None].astype(jnp.int8)
-                return hist_from_rows_int(rows, pay, B, hmethod)
+                return hist_from_rows_int(rows, pay, B, hmethod), nu
             pay = jnp.take(gw3, idx, axis=0) * inp[:, None].astype(dtype)
-            return hist_from_rows(rows, pay, B, hmethod)
+            return hist_from_rows(rows, pay, B, hmethod), nu
         return br
 
     part_branches = [make_part(S) for S in sizes]
@@ -549,8 +615,24 @@ def _grow_compact_impl(cfg: GrowConfig,
         leaf_count=tree.leaf_count.at[0].set(total_c),
     )
     best = _BestSplits.init(L, B, dtype)
+    root_mask = None if interaction_groups is None \
+        else allowed_features(jnp.zeros((F,), jnp.bool_))
+    cegb_state = ()
+    root_pen = None
+    if cegb:
+        coupled_used = coupled_used0
+        if cegb_lazy:
+            lazy_used = lazy_used0
+            root_nu = jnp.sum(~lazy_used, axis=0).astype(dtype)   # [F]
+        else:
+            lazy_used = jnp.zeros((1, 1), jnp.bool_)
+            root_nu = jnp.zeros((F,), dtype)
+        lazy_nu = jnp.zeros((L, F), dtype).at[0].set(root_nu)
+        cegb_state = (coupled_used, lazy_used, lazy_nu)
+        root_pen = cegb_penalty(jnp.asarray(n, jnp.int32), coupled_used,
+                                root_nu)
     best = best.store(0, best_for(hist_f(root_hist), total_g, total_h,
-                                  total_c),
+                                  total_c, root_mask, root_pen),
                       jnp.asarray(True))
     hists = jnp.zeros((L, F, B, 3),
                       jnp.int32 if quant else dtype).at[0].set(root_hist)
@@ -559,26 +641,33 @@ def _grow_compact_impl(cfg: GrowConfig,
         order=jnp.arange(n, dtype=jnp.int32),
         leaf_begin=jnp.zeros((L,), jnp.int32),
         leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(n),
-        num_splits=jnp.asarray(0, jnp.int32))
+        branch=jnp.zeros((L, F), jnp.bool_),
+        num_splits=jnp.asarray(0, jnp.int32),
+        cegb=cegb_state)
 
     def depth_ok(d):
         if cfg.max_depth <= 0:
             return jnp.asarray(True)
         return d < cfg.max_depth
 
-    def do_split(state: _CompactState) -> _CompactState:
-        tree, best, hists, order, lbegin, lcount, ns = state
-        leaf = jnp.argmax(best.gain).astype(jnp.int32)
+    def do_split(state: _CompactState,
+                 leaf_override=None) -> _CompactState:
+        (tree, best, hists, order, lbegin, lcount, branch, ns,
+         cegb_st) = state
+        leaf = jnp.argmax(best.gain).astype(jnp.int32) \
+            if leaf_override is None else leaf_override
         R = ns + 1
         start = lbegin[leaf]
         cnt = lcount[leaf]
+        f_split = best.feature[leaf]
+        lazy_arr = cegb_st[1] if cegb else jnp.zeros((1, 1), jnp.bool_)
 
         # -- partition the leaf's range (DataPartition::Split analog) --
-        order, n_left = lax.switch(
+        order, n_left, lazy_arr = lax.switch(
             bucket_idx(cnt), part_branches, order, start, cnt,
-            best.feature[leaf], best.threshold_bin[leaf],
+            f_split, best.threshold_bin[leaf],
             best.default_left[leaf], best.is_cat[leaf],
-            best.cat_mask[leaf])
+            best.cat_mask[leaf], lazy_arr)
         lbegin = lbegin.at[R].set(start + n_left)
         lcount = lcount.at[leaf].set(n_left).at[R].set(cnt - n_left)
 
@@ -591,8 +680,10 @@ def _grow_compact_impl(cfg: GrowConfig,
         left_smaller = best.left_count[leaf] <= best.right_count[leaf]
         s_start = jnp.where(left_smaller, start, start + n_left)
         s_cnt = jnp.where(left_smaller, n_left, cnt - n_left)
-        small_hist = psum(lax.switch(
-            bucket_idx(s_cnt), hist_branches, order, s_start, s_cnt))
+        small_hist, small_nu = lax.switch(
+            bucket_idx(s_cnt), hist_branches, order, s_start, s_cnt,
+            lazy_arr)
+        small_hist = psum(small_hist)
         parent_hist = hists[leaf]
         big_hist = subtract_histogram(parent_hist, small_hist)
         left_hist = jnp.where(left_smaller, small_hist, big_hist)
@@ -601,22 +692,92 @@ def _grow_compact_impl(cfg: GrowConfig,
 
         # -- child best splits --
         can_go_deeper = depth_ok(new_depth)
+        child_mask = None
+        if interaction_groups is not None:
+            nb = branch[leaf] | (jnp.arange(F) == f_split)
+            branch = branch.at[leaf].set(nb).at[R].set(nb)
+            child_mask = allowed_features(nb)
+        pen_l = pen_r = None
+        if cegb:
+            coupled_used, _, lazy_nu = cegb_st
+            first_use = ~coupled_used[f_split]
+            # refund the coupled penalty on other leaves' stored best
+            # candidates that use this feature (UpdateLeafBestSplits)
+            refund = cfg.cegb_tradeoff * pen_coupled[f_split]
+            best = best._replace(gain=jnp.where(
+                (best.feature == f_split) & first_use
+                & jnp.isfinite(best.gain),
+                best.gain + refund, best.gain))
+            coupled_used = coupled_used | (jnp.arange(F) == f_split)
+            # parent rows acquired f_split during partition; counts for
+            # the children follow by subtraction on the updated parent
+            parent_nu = lazy_nu[leaf].at[f_split].set(0.0)
+            big_nu = jnp.maximum(parent_nu - small_nu, 0.0)
+            left_nu = jnp.where(left_smaller, small_nu, big_nu)
+            right_nu = jnp.where(left_smaller, big_nu, small_nu)
+            lazy_nu = lazy_nu.at[leaf].set(left_nu).at[R].set(right_nu)
+            cegb_st = (coupled_used, lazy_arr, lazy_nu)
+            pen_l = cegb_penalty(n_left, coupled_used, left_nu)
+            pen_r = cegb_penalty(cnt - n_left, coupled_used, right_nu)
         rl = best_for(hist_f(left_hist), best.left_sum_g[leaf],
-                      best.left_sum_h[leaf], best.left_count[leaf])
+                      best.left_sum_h[leaf], best.left_count[leaf],
+                      child_mask, pen_l)
         rr = best_for(hist_f(right_hist), best.right_sum_g[leaf],
-                      best.right_sum_h[leaf], best.right_count[leaf])
+                      best.right_sum_h[leaf], best.right_count[leaf],
+                      child_mask, pen_r)
         best = best.store(leaf, rl, can_go_deeper)
         best = best.store(R, rr, can_go_deeper)
 
         return _CompactState(tree=tree, best=best, hists=hists, order=order,
                              leaf_begin=lbegin, leaf_count=lcount,
-                             num_splits=ns + 1)
+                             branch=branch, num_splits=ns + 1,
+                             cegb=cegb_st)
+
+    def forced_result(hist, f, t) -> SplitResult:
+        """Fixed (feature, bin) split record from a leaf's histogram
+        (SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:620).
+        Missing values route right (default_left=False)."""
+        totals = jnp.sum(hist[0], axis=0)          # every row hits feat 0
+        tg, th, tc = totals[0], totals[1], totals[2]
+        h = hist[f]                                # [B, 3]
+        binsb = jnp.arange(B)
+        nanb = feat_nan_bin[f]
+        sel = (binsb <= t) & ~((binsb == nanb) & (nanb >= 0))
+        left = jnp.sum(h * sel[:, None].astype(h.dtype), axis=0)
+        lg, lh, lc = left[0], left[1], left[2]
+        rg, rh, rc = tg - lg, th - lh, tc - lc
+        gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p) \
+            - leaf_gain(tg, th, p)
+        false_ = jnp.asarray(False)
+        return SplitResult(
+            gain=gain.astype(dtype), feature=f, threshold_bin=t,
+            default_left=false_, is_cat=false_,
+            cat_mask=jnp.zeros((B,), jnp.bool_),
+            left_sum_g=lg, left_sum_h=lh, left_count=lc,
+            right_sum_g=rg, right_sum_h=rh, right_count=rc,
+            left_output=leaf_output(lg, lh, p),
+            right_output=leaf_output(rg, rh, p))
+
+    def forced_step(state: _CompactState, leaf, f, t) -> _CompactState:
+        r = forced_result(hist_f(state.hists[leaf]), f, t)
+        valid = (r.left_count > 0) & (r.right_count > 0)
+        forced_state = state._replace(best=state.best.store(leaf, r,
+                                                            jnp.asarray(True)))
+        return lax.cond(valid,
+                        lambda s: do_split(s, leaf_override=leaf),
+                        lambda _: state, forced_state)
 
     def step(_, state: _CompactState) -> _CompactState:
         can = jnp.max(state.best.gain) > 0.0
         return lax.cond(can, do_split, lambda s: s, state)
 
-    state = lax.fori_loop(0, L - 1, step, state)
+    M = 0
+    if forced is not None:
+        f_leaf, f_feat, f_bin = forced
+        M = min(int(f_leaf.shape[0]), L - 1)
+        for i in range(M):
+            state = forced_step(state, f_leaf[i], f_feat[i], f_bin[i])
+    state = lax.fori_loop(M, L - 1, step, state)
     row_leaf = _row_leaf_from_order(state.order, state.leaf_begin,
                                     state.leaf_count, n, L)
     tree = state.tree
@@ -629,6 +790,8 @@ def _grow_compact_impl(cfg: GrowConfig,
         lv = jnp.where(jnp.arange(L) < tree.num_leaves, newv,
                        tree.leaf_value)
         tree = tree._replace(leaf_value=lv)
+    if cegb:
+        return tree, row_leaf, state.cegb[0], state.cegb[1]
     return tree, row_leaf
 
 

@@ -174,7 +174,8 @@ def find_best_split(hist: jnp.ndarray,
                     feature_mask: jnp.ndarray,
                     p: SplitParams,
                     monotone_constraints: jnp.ndarray | None = None,
-                    feat_is_cat: jnp.ndarray | None = None
+                    feat_is_cat: jnp.ndarray | None = None,
+                    gain_penalty: jnp.ndarray | None = None
                     ) -> SplitResult:
     """Find the best (feature, threshold) over a leaf's histograms.
 
@@ -185,6 +186,8 @@ def find_best_split(hist: jnp.ndarray,
       feat_nan_bin: ``[F]`` i32 — index of the NaN bin, or -1.
       feature_mask: ``[F]`` bool — column-sampling / trivial-feature mask.
       monotone_constraints: optional ``[F]`` i8 in {-1, 0, +1}.
+      gain_penalty: optional ``[F]`` — per-feature gain penalty (CEGB
+        DeltaGain) subtracted from every candidate of that feature.
 
     Returns a scalar SplitResult; ``gain`` is already shifted by the parent
     gain and min_gain_to_split (so "> 0" means worth splitting).
@@ -259,6 +262,8 @@ def find_best_split(hist: jnp.ndarray,
     else:
         stacks = [gains_r, gains_l]
 
+    if gain_penalty is not None:
+        stacks = [g - gain_penalty[:, None] for g in stacks]
     # argmax with deterministic tie-breaking: lower (dir, feature, bin) wins
     all_gains = jnp.stack(stacks)  # [D, F, B]
     flat_idx = jnp.argmax(all_gains)
