@@ -175,6 +175,7 @@ class GBDTBooster:
             stochastic=cfg.stochastic_rounding,
             cegb=self.cegb_enabled,
             cegb_lazy=self.cegb_lazy,
+            cegb_coupled=len(cfg.cegb_penalty_feature_coupled) > 0,
             cegb_tradeoff=cfg.cegb_tradeoff,
             cegb_split=cfg.cegb_penalty_split,
             split=SplitParams(

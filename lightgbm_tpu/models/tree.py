@@ -17,6 +17,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional, Sequence
 
+import jax
 import numpy as np
 
 from ..ops.binning import BinMapper, BinType, MissingType
@@ -244,6 +245,10 @@ def tree_from_arrays(dev_tree, mappers: Sequence[BinMapper],
                      used_features: Optional[np.ndarray] = None) -> Tree:
     """Convert device TreeArrays (ops/grow.py) to a host Tree, realizing
     bin-space thresholds as real values via the BinMappers."""
+    # one batched device->host fetch for the whole pytree: per-field
+    # np.asarray would pay a device round-trip per array (a dozen
+    # pipeline stalls per boosting iteration)
+    dev_tree = jax.device_get(dev_tree)
     L = int(np.asarray(dev_tree.num_leaves))
     nn = max(L - 1, 0)
     inner_sf = np.asarray(dev_tree.split_feature)[:nn].astype(np.int32)

@@ -74,6 +74,7 @@ class GrowConfig(NamedTuple):
     # splits / first feature use / per-row feature acquisition
     cegb: bool = False
     cegb_lazy: bool = False
+    cegb_coupled: bool = False   # any cegb_penalty_feature_coupled > 0
     cegb_tradeoff: float = 1.0
     cegb_split: float = 0.0
 
@@ -417,7 +418,7 @@ class _CompactState(NamedTuple):
     branch: jnp.ndarray      # [L, F] bool — features used on leaf's path
     num_splits: jnp.ndarray  # scalar i32
     cegb: tuple = ()         # (coupled_used [F], lazy_used [n,F],
-                             #  lazy_nu [L,F]) when cfg.cegb
+                             #  lazy_nu [L,F], leaf_ib [L]) when cfg.cegb
 
 
 def _row_leaf_from_order(order, leaf_begin, leaf_count, n, L):
@@ -487,10 +488,16 @@ def _grow_compact_impl(cfg: GrowConfig,
 
     cegb = cfg.cegb
     cegb_lazy = cfg.cegb_lazy and cegb
+    cegb_coupled = cfg.cegb_coupled and cegb
     if cegb:
         pen_coupled, pen_lazy, coupled_used0, lazy_used0 = cegb_arrays
         if cegb_lazy and lazy_used0 is None:
             raise ValueError("cegb_lazy requires a lazy_used matrix")
+        # penalties count in-bag rows only: the reference's
+        # num_data_in_leaf / GetIndexOnLeaf walk the bagged partition
+        # (cost_effective_gradient_boosting.hpp:81,128-137), which holds
+        # no out-of-bag rows.
+        inbag = row_weight > 0
 
         def cegb_penalty(cnt, coupled_used, lazy_nu_leaf):
             """DeltaGain (cost_effective_gradient_boosting.hpp:81-97):
@@ -565,11 +572,18 @@ def _grow_compact_impl(cfg: GrowConfig,
             perm = jnp.argsort(key, stable=True)
             order2 = lax.dynamic_update_slice(order, idx[perm], (start_c,))
             n_left = jnp.sum((inp & gl).astype(jnp.int32))
+            if cegb:
+                ib = inbag[idx]
+                n_left_ib = jnp.sum((inp & gl & ib).astype(jnp.int32))
+                n_ib = jnp.sum((inp & ib).astype(jnp.int32))
+            else:
+                n_left_ib = n_ib = jnp.asarray(0, jnp.int32)
             if cegb_lazy:
-                # the split acquires feature f for every row in the leaf
-                # (UpdateLeafBestSplits' InsertBitset loop)
-                lazy_used = lazy_used.at[idx, f].max(inp)
-            return order2, n_left, lazy_used
+                # the split acquires feature f for every in-bag row in the
+                # leaf (UpdateLeafBestSplits' InsertBitset loop over the
+                # bagged partition)
+                lazy_used = lazy_used.at[idx, f].max(inp & ib)
+            return order2, n_left, n_left_ib, n_ib, lazy_used
         return br
 
     def make_hist(S):
@@ -582,7 +596,7 @@ def _grow_compact_impl(cfg: GrowConfig,
             rows = jnp.take(bins_rm, idx, axis=0)
             if cegb_lazy:
                 used_rows = jnp.take(lazy_used, idx, axis=0)  # [S, F]
-                nu = jnp.sum(inp[:, None] & ~used_rows,
+                nu = jnp.sum((inp & inbag[idx])[:, None] & ~used_rows,
                              axis=0).astype(dtype)
             else:
                 nu = jnp.zeros((F,), dtype)
@@ -623,14 +637,16 @@ def _grow_compact_impl(cfg: GrowConfig,
         coupled_used = coupled_used0
         if cegb_lazy:
             lazy_used = lazy_used0
-            root_nu = jnp.sum(~lazy_used, axis=0).astype(dtype)   # [F]
+            root_nu = jnp.sum(~lazy_used & inbag[:, None],
+                              axis=0).astype(dtype)               # [F]
         else:
             lazy_used = jnp.zeros((1, 1), jnp.bool_)
             root_nu = jnp.zeros((F,), dtype)
         lazy_nu = jnp.zeros((L, F), dtype).at[0].set(root_nu)
-        cegb_state = (coupled_used, lazy_used, lazy_nu)
-        root_pen = cegb_penalty(jnp.asarray(n, jnp.int32), coupled_used,
-                                root_nu)
+        root_ib = jnp.sum(inbag.astype(jnp.int32))
+        leaf_ib = jnp.zeros((L,), jnp.int32).at[0].set(root_ib)
+        cegb_state = (coupled_used, lazy_used, lazy_nu, leaf_ib)
+        root_pen = cegb_penalty(root_ib, coupled_used, root_nu)
     best = best.store(0, best_for(hist_f(root_hist), total_g, total_h,
                                   total_c, root_mask, root_pen),
                       jnp.asarray(True))
@@ -663,7 +679,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         lazy_arr = cegb_st[1] if cegb else jnp.zeros((1, 1), jnp.bool_)
 
         # -- partition the leaf's range (DataPartition::Split analog) --
-        order, n_left, lazy_arr = lax.switch(
+        order, n_left, n_left_ib, n_ib, lazy_arr = lax.switch(
             bucket_idx(cnt), part_branches, order, start, cnt,
             f_split, best.threshold_bin[leaf],
             best.default_left[leaf], best.is_cat[leaf],
@@ -699,15 +715,8 @@ def _grow_compact_impl(cfg: GrowConfig,
             child_mask = allowed_features(nb)
         pen_l = pen_r = None
         if cegb:
-            coupled_used, _, lazy_nu = cegb_st
-            first_use = ~coupled_used[f_split]
-            # refund the coupled penalty on other leaves' stored best
-            # candidates that use this feature (UpdateLeafBestSplits)
-            refund = cfg.cegb_tradeoff * pen_coupled[f_split]
-            best = best._replace(gain=jnp.where(
-                (best.feature == f_split) & first_use
-                & jnp.isfinite(best.gain),
-                best.gain + refund, best.gain))
+            coupled_used, _, lazy_nu, leaf_ib = cegb_st
+            first_use = ~coupled_used[f_split] & (pen_coupled[f_split] > 0)
             coupled_used = coupled_used | (jnp.arange(F) == f_split)
             # parent rows acquired f_split during partition; counts for
             # the children follow by subtraction on the updated parent
@@ -716,9 +725,11 @@ def _grow_compact_impl(cfg: GrowConfig,
             left_nu = jnp.where(left_smaller, small_nu, big_nu)
             right_nu = jnp.where(left_smaller, big_nu, small_nu)
             lazy_nu = lazy_nu.at[leaf].set(left_nu).at[R].set(right_nu)
-            cegb_st = (coupled_used, lazy_arr, lazy_nu)
-            pen_l = cegb_penalty(n_left, coupled_used, left_nu)
-            pen_r = cegb_penalty(cnt - n_left, coupled_used, right_nu)
+            leaf_ib = leaf_ib.at[leaf].set(n_left_ib) \
+                             .at[R].set(n_ib - n_left_ib)
+            cegb_st = (coupled_used, lazy_arr, lazy_nu, leaf_ib)
+            pen_l = cegb_penalty(n_left_ib, coupled_used, left_nu)
+            pen_r = cegb_penalty(n_ib - n_left_ib, coupled_used, right_nu)
         rl = best_for(hist_f(left_hist), best.left_sum_g[leaf],
                       best.left_sum_h[leaf], best.left_count[leaf],
                       child_mask, pen_l)
@@ -727,6 +738,47 @@ def _grow_compact_impl(cfg: GrowConfig,
                       child_mask, pen_r)
         best = best.store(leaf, rl, can_go_deeper)
         best = best.store(R, rr, can_go_deeper)
+
+        if cegb_coupled:
+            # First use of a coupled-penalized feature erases its penalty
+            # everywhere, which can promote another leaf's non-best
+            # candidate to best. The reference patches the stored
+            # per-(leaf, feature) candidates (UpdateLeafBestSplits,
+            # cost_effective_gradient_boosting.hpp:100-124); we hold the
+            # per-leaf histograms in HBM, so an exact re-search of every
+            # leaf under the updated penalty is the same result.
+            coupled_used, _, lazy_nu, leaf_ib = cegb_st
+
+            def research(best):
+                hf = jax.vmap(hist_f)(hists)              # [L, F, B, 3]
+                sums = hf[:, 0].sum(axis=1)               # [L, 3]
+                pens = jax.vmap(cegb_penalty,
+                                in_axes=(0, None, 0))(leaf_ib,
+                                                      coupled_used,
+                                                      lazy_nu)
+                masks = None if interaction_groups is None \
+                    else jax.vmap(allowed_features)(branch)
+                r = jax.vmap(best_for, in_axes=(0, 0, 0, 0,
+                                                None if masks is None
+                                                else 0, 0))(
+                    hf, sums[:, 0], sums[:, 1], sums[:, 2], masks, pens)
+                if cfg.max_depth > 0:
+                    allowed = tree.leaf_depth < cfg.max_depth
+                else:
+                    allowed = jnp.ones((L,), jnp.bool_)
+                return _BestSplits(
+                    gain=jnp.where(allowed, r.gain, NEG_INF),
+                    feature=r.feature, threshold_bin=r.threshold_bin,
+                    default_left=r.default_left, is_cat=r.is_cat,
+                    cat_mask=r.cat_mask,
+                    left_sum_g=r.left_sum_g, left_sum_h=r.left_sum_h,
+                    left_count=r.left_count,
+                    right_sum_g=r.right_sum_g, right_sum_h=r.right_sum_h,
+                    right_count=r.right_count,
+                    left_output=r.left_output,
+                    right_output=r.right_output)
+
+            best = lax.cond(first_use, research, lambda b: b, best)
 
         return _CompactState(tree=tree, best=best, hists=hists, order=order,
                              leaf_begin=lbegin, leaf_count=lcount,
