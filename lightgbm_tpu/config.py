@@ -486,9 +486,14 @@ class Config:
     num_devices: int = 0  # 0 = use all visible devices for data-parallel
     hist_dtype: str = "float32"  # histogram accumulator dtype
     sharding_axis: str = "data"  # mesh axis name for row sharding
-    # histogram build strategy: auto|scatter|onehot|mxu (auto: nibble
+    # histogram build strategy: auto|scatter|mxu (auto: nibble
     # matmul on TPU — rides the MXU — and scatter-add on CPU)
     hist_method: str = "auto"
+    # MXU histogram accumulation passes: default (single-pass bf16 input /
+    # f32 accumulation — the reference GPU learner's single-precision
+    # histogram choice, docs/GPU-Performance.rst:134-158) | high (3-pass)
+    # | highest (6-pass f32 emulation)
+    hist_precision: str = "default"
     # tree grower: compact (rows grouped by leaf; per-split work ~ leaf
     # size) | masked (full-row masked histogram passes)
     grower: str = "compact"
@@ -563,6 +568,11 @@ class Config:
             raise ValueError(
                 f"Unknown monotone_constraints_method: "
                 f"{self.monotone_constraints_method}")
+        if self.hist_method not in ("auto", "scatter", "mxu"):
+            raise ValueError(f"Unknown hist_method: {self.hist_method}")
+        if self.hist_precision not in ("default", "high", "highest"):
+            raise ValueError(
+                f"Unknown hist_precision: {self.hist_precision}")
         for name, spec in self._BOUNDS.items():
             lo, hi = spec[0], spec[1]
             strict = len(spec) > 2 and spec[2] == "gt"

@@ -169,6 +169,7 @@ class GBDTBooster:
             max_depth=cfg.max_depth,
             grower=grower,
             hist_method=hist_method,
+            hist_precision=cfg.hist_precision,
             quantized=cfg.use_quantized_grad,
             quant_bins=cfg.num_grad_quant_bins,
             renew_leaf=cfg.quant_train_renew_leaf,

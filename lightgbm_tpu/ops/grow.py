@@ -39,9 +39,6 @@ __all__ = ["GrowConfig", "TreeArrays", "grow_tree"]
 
 NEG_INF = -jnp.inf
 
-MIN_BUCKET = 4096  # smallest compact work-window (powers of 2 upward)
-
-
 class GrowConfig(NamedTuple):
     """Static (trace-time) growth configuration.
 
@@ -61,6 +58,8 @@ class GrowConfig(NamedTuple):
     max_depth: int = -1
     split: SplitParams = SplitParams()
     hist_method: str = "scatter"
+    hist_precision: str = "default"  # mxu matmul passes: default|high|highest
+    chunk: int = 16384           # rows per streaming chunk (compact grower)
     axis_name: Optional[str] = None
     grower: str = "compact"
     # quantized-gradient training (use_quantized_grad; the reference's
@@ -156,7 +155,7 @@ class _BestSplits(NamedTuple):
 class _GrowState(NamedTuple):
     tree: TreeArrays
     best: _BestSplits
-    hists: jnp.ndarray      # [L, F, B, 3]
+    hists: jnp.ndarray      # [L, F, B, 2]
     row_leaf: jnp.ndarray   # [n] i32
     num_splits: jnp.ndarray  # scalar i32
 
@@ -184,11 +183,16 @@ def _init_tree(L: int, B: int, dtype) -> TreeArrays:
 
 
 def _apply_split_to_tree(tree: TreeArrays, best: _BestSplits, leaf, R, ns,
-                         p: SplitParams) -> TreeArrays:
+                         p: SplitParams, left_cnt=None,
+                         right_cnt=None) -> TreeArrays:
     """Record split ``ns`` of leaf slot ``leaf`` (Tree::Split, tree.h:63).
 
     The left child keeps the parent's leaf slot; the right child takes
-    slot ``R``; internal node ``ns`` is created by this split."""
+    slot ``R``; internal node ``ns`` is created by this split.
+    ``left_cnt``/``right_cnt`` are the exact partition counts when the
+    caller has them (SplitInner overwrites the search-time estimates the
+    same way, serial_tree_learner.cpp:789-791); the stored candidate
+    counts are hessian-ratio estimates otherwise."""
     f = best.feature[leaf]
     t = best.threshold_bin[leaf]
     dl = best.default_left[leaf]
@@ -203,9 +207,11 @@ def _apply_split_to_tree(tree: TreeArrays, best: _BestSplits, leaf, R, ns,
                                    ns, rc[pidx]))
     lc = lc.at[ns].set(~leaf)
     rc = rc.at[ns].set(~R)
+    lcnt = best.left_count[leaf] if left_cnt is None else left_cnt
+    rcnt = best.right_count[leaf] if right_cnt is None else right_cnt
     parent_g = best.left_sum_g[leaf] + best.right_sum_g[leaf]
     parent_h = best.left_sum_h[leaf] + best.right_sum_h[leaf]
-    parent_c = best.left_count[leaf] + best.right_count[leaf]
+    parent_c = lcnt + rcnt
     new_depth = tree.leaf_depth[leaf] + 1
     return tree._replace(
         split_feature=tree.split_feature.at[ns].set(f),
@@ -224,8 +230,7 @@ def _apply_split_to_tree(tree: TreeArrays, best: _BestSplits, leaf, R, ns,
         .at[R].set(best.right_output[leaf]),
         leaf_weight=tree.leaf_weight.at[leaf].set(best.left_sum_h[leaf])
         .at[R].set(best.right_sum_h[leaf]),
-        leaf_count=tree.leaf_count.at[leaf].set(best.left_count[leaf])
-        .at[R].set(best.right_count[leaf]),
+        leaf_count=tree.leaf_count.at[leaf].set(lcnt).at[R].set(rcnt),
         leaf_parent=tree.leaf_parent.at[leaf].set(ns).at[R].set(ns),
         leaf_depth=tree.leaf_depth.at[leaf].set(new_depth)
         .at[R].set(new_depth),
@@ -308,12 +313,14 @@ def _grow_masked_impl(cfg: GrowConfig,
 
     # ---- root (GlobalSyncUpBySum analog for the root tuple) ----
     w = row_weight.astype(dtype)
+    inbag = row_weight > 0
     total_g = psum(jnp.sum(grad * w))
     total_h = psum(jnp.sum(hess * w))
-    total_c = psum(jnp.sum(w))
+    total_c = psum(jnp.sum(inbag.astype(dtype)))
     all_rows = jnp.ones((n,), jnp.bool_)
     root_hist = psum(build_histogram(bins_T, grad, hess, row_weight,
-                                     all_rows, B, cfg.hist_method))
+                                     all_rows, B, cfg.hist_method,
+                                     cfg.hist_precision))
 
     tree = _init_tree(L, B, dtype)
     tree = tree._replace(
@@ -324,7 +331,7 @@ def _grow_masked_impl(cfg: GrowConfig,
     best = _BestSplits.init(L, B, dtype)
     best = best.store(0, best_for(root_hist, total_g, total_h, total_c),
                       jnp.asarray(True))
-    hists = jnp.zeros((L, F, B, 3), dtype).at[0].set(root_hist)
+    hists = jnp.zeros((L, F, B, 2), dtype).at[0].set(root_hist)
     state = _GrowState(tree=tree, best=best, hists=hists,
                        row_leaf=jnp.zeros((n,), jnp.int32),
                        num_splits=jnp.asarray(0, jnp.int32))
@@ -351,18 +358,24 @@ def _grow_masked_impl(cfg: GrowConfig,
         cm = best.cat_mask[leaf]
         go_left = jnp.where(best.is_cat[leaf], cm[col], go_left_num)
         on_leaf = row_leaf == leaf
+        # exact partition counts replace the search-time hessian-ratio
+        # estimates (SplitInner update_cnt, serial_tree_learner.cpp:789)
+        nl_ex = psum(jnp.sum((on_leaf & go_left & inbag).astype(dtype)))
+        nr_ex = tree.leaf_count[leaf] - nl_ex
         row_leaf = jnp.where(on_leaf & ~go_left, R, row_leaf)
 
         # -- tree arrays update (Tree::Split, tree.h:63) --
         new_depth = tree.leaf_depth[leaf] + 1
-        tree = _apply_split_to_tree(tree, best, leaf, R, ns, p)
+        tree = _apply_split_to_tree(tree, best, leaf, R, ns, p,
+                                    nl_ex, nr_ex)
 
         # -- histograms: scatter the smaller child, subtract for sibling --
-        left_smaller = best.left_count[leaf] <= best.right_count[leaf]
+        left_smaller = nl_ex <= nr_ex
         small_slot = jnp.where(left_smaller, leaf, R)
         small_mask = row_leaf == small_slot
         small_hist = psum(build_histogram(bins_T, grad, hess, row_weight,
-                                          small_mask, B, cfg.hist_method))
+                                          small_mask, B, cfg.hist_method,
+                                          cfg.hist_precision))
         parent_hist = hists[leaf]
         big_hist = subtract_histogram(parent_hist, small_hist)
         left_hist = jnp.where(left_smaller, small_hist, big_hist)
@@ -372,9 +385,9 @@ def _grow_masked_impl(cfg: GrowConfig,
         # -- child best splits --
         can_go_deeper = depth_ok(new_depth)
         rl = best_for(left_hist, best.left_sum_g[leaf],
-                      best.left_sum_h[leaf], best.left_count[leaf])
+                      best.left_sum_h[leaf], nl_ex)
         rr = best_for(right_hist, best.right_sum_g[leaf],
-                      best.right_sum_h[leaf], best.right_count[leaf])
+                      best.right_sum_h[leaf], nr_ex)
         best = best.store(leaf, rl, can_go_deeper)
         best = best.store(R, rr, can_go_deeper)
 
@@ -393,38 +406,32 @@ def _grow_masked_impl(cfg: GrowConfig,
 # Compact grower: rows grouped by leaf (DataPartition re-imagined)
 # ---------------------------------------------------------------------------
 
-def _bucket_sizes(n: int) -> list:
-    """Power-of-2 work-window sizes up to n (n itself is the top window).
-
-    The compact grower's dynamic leaf ranges are processed through
-    static-shape windows (XLA needs static shapes); a leaf of size s pays
-    for the smallest window >= s, i.e. at most 2x the optimal work."""
-    sizes = []
-    s = MIN_BUCKET
-    while s < n:
-        sizes.append(s)
-        s *= 2
-    sizes.append(n)
-    return sizes
-
-
 class _CompactState(NamedTuple):
     tree: TreeArrays
     best: _BestSplits
-    hists: jnp.ndarray       # [L, F, B, 3]
-    order: jnp.ndarray       # [n] i32 — row ids grouped by leaf
+    hists: jnp.ndarray       # [L, F, B, 2] (sum_grad, sum_hess)
+    bins_ord: jnp.ndarray    # [n+K, F] u8/u16 — bin rows grouped by leaf
+    pay_ord: jnp.ndarray     # [n+K, 2] f32/i8 — (g, h) payload, same order
+    ib_ord: jnp.ndarray      # [n+K] bool — in-bag flags, same order
+    order: jnp.ndarray       # [n+K] i32 — original row ids, same order
+    scratch: tuple           # 8 same-shape partition scratch windows
+                             # (L/R x bins/pay/ib/order); contents are
+                             # per-split scratch, never reset
     leaf_begin: jnp.ndarray  # [L] i32 (local raw offsets)
     leaf_count: jnp.ndarray  # [L] i32 (local raw counts)
     branch: jnp.ndarray      # [L, F] bool — features used on leaf's path
     num_splits: jnp.ndarray  # scalar i32
     cegb: tuple = ()         # (coupled_used [F], lazy_used [n,F],
-                             #  lazy_nu [L,F], leaf_ib [L]) when cfg.cegb
+                             #  lazy_nu [L,F]) when cfg.cegb
 
 
 def _row_leaf_from_order(order, leaf_begin, leaf_count, n, L):
     """Recover the per-row leaf assignment from the grouped order:
     ranges partition [0, n); mark each active range start, prefix-sum to
-    a segment id, map segments to leaves via the begin-sorted leaf list."""
+    a segment id, map segments to leaves via the begin-sorted leaf list.
+    The final positional->row-id inversion runs as a variadic sort (a
+    vectorized sorting network) rather than a scatter, which XLA:TPU
+    serializes per element."""
     active = leaf_count > 0
     keys = jnp.where(active, leaf_begin, n + 1)
     ls = jnp.argsort(keys)  # leaves ordered by begin, inactive last
@@ -433,8 +440,8 @@ def _row_leaf_from_order(order, leaf_begin, leaf_count, n, L):
         jnp.clip(leaf_begin[ls], 0, n - 1)].add(flag)
     seg = jnp.cumsum(marks) - 1
     leaf_of_pos = ls[jnp.clip(seg, 0, L - 1)].astype(jnp.int32)
-    return jnp.zeros((n,), jnp.int32).at[order].set(
-        leaf_of_pos, unique_indices=True)
+    _, row_leaf = lax.sort((order, leaf_of_pos), num_keys=1)
+    return row_leaf
 
 
 def _grow_compact_impl(cfg: GrowConfig,
@@ -451,22 +458,30 @@ def _grow_compact_impl(cfg: GrowConfig,
                        interaction_groups: Optional[jnp.ndarray] = None,
                        forced: Optional[tuple] = None,
                        cegb_arrays: Optional[tuple] = None):
-    """Leaf-wise growth with rows kept grouped by leaf.
+    """Leaf-wise growth with rows kept PHYSICALLY grouped by leaf.
 
     The reference's DataPartition (data_partition.hpp) + CUDA partition
-    (cuda_data_partition.cu) analog: an ``order`` array holds row ids
-    grouped by leaf so each split's histogram gathers only that leaf's
-    rows (cost ~ leaf size, not n). Histograms ride the MXU via the
-    nibble decomposition (histogram.py). Partitioning is a stable
-    argsort of a 4-way key inside a clamped static window."""
+    (cuda_data_partition.cu) analog, re-shaped for the TPU memory
+    system: the bin rows, payload, in-bag flags and row ids are
+    physically re-ordered on every split so each leaf occupies a
+    contiguous range. All per-split work then streams CONTIGUOUS
+    fixed-size chunks through ``lax.fori_loop`` bodies — no random
+    gathers (TPU gathers serialize per element) and no ``lax.switch``
+    over window sizes (XLA copies big conditional operands; while-loop
+    carries alias in place). Histograms ride the MXU via the nibble
+    decomposition (histogram.py); the partition is a two-pass stable
+    compaction (count, then permute-to-scratch + copy-back) — the CUDA
+    bit-vector + prefix-sum pattern."""
     L = cfg.num_leaves
     B = cfg.num_bins
     F = bins_T.shape[0]
     n = bins_T.shape[1]
     dtype = grad.dtype
     p = cfg.split
-    sizes = _bucket_sizes(n)
-    sizes_arr = jnp.asarray(sizes, jnp.int32)
+    K = cfg.chunk
+    while K >= 2 * n:
+        K //= 2
+    K = max(K, 256)
 
     def psum(x):
         return lax.psum(x, cfg.axis_name) if cfg.axis_name else x
@@ -493,12 +508,11 @@ def _grow_compact_impl(cfg: GrowConfig,
         pen_coupled, pen_lazy, coupled_used0, lazy_used0 = cegb_arrays
         if cegb_lazy and lazy_used0 is None:
             raise ValueError("cegb_lazy requires a lazy_used matrix")
-        # penalties count in-bag rows only: the reference's
+
+        # Penalties count in-bag rows only: the reference's
         # num_data_in_leaf / GetIndexOnLeaf walk the bagged partition
         # (cost_effective_gradient_boosting.hpp:81,128-137), which holds
         # no out-of-bag rows.
-        inbag = row_weight > 0
-
         def cegb_penalty(cnt, coupled_used, lazy_nu_leaf):
             """DeltaGain (cost_effective_gradient_boosting.hpp:81-97):
             tradeoff * (penalty_split*n + coupled-first-use + lazy)."""
@@ -512,22 +526,21 @@ def _grow_compact_impl(cfg: GrowConfig,
 
     bins_rm = bins_T.T                      # [n, F] row-major for gathers
     w = row_weight.astype(dtype)
-    gw3 = jnp.stack([grad * w, hess * w, w], axis=-1)  # [n, 3]
+    inbag = row_weight > 0
+    gw2 = jnp.stack([grad * w, hess * w], axis=-1)  # [n, 2]
     # "onehot" has no gathered-rows analog; it maps to the MXU kernel
     hmethod = "scatter" if cfg.hist_method == "scatter" else "mxu"
 
     quant = cfg.quantized
     if quant:
         # GradientDiscretizer analog (gradient_discretizer.hpp:35):
-        # per-tree scales, stochastic rounding, int8 payload. Counts are
-        # in-bag row counts (the reference also counts rows, not weights,
-        # on the quantized path).
+        # per-tree scales, stochastic rounding, int8 payload.
         def pmax(x):
             return lax.pmax(x, cfg.axis_name) if cfg.axis_name else x
 
         half = max(1, cfg.quant_bins // 2)
-        gs = jnp.maximum(pmax(jnp.max(jnp.abs(gw3[:, 0]))), 1e-30) / half
-        hs = jnp.maximum(pmax(jnp.max(gw3[:, 1])), 1e-30) \
+        gs = jnp.maximum(pmax(jnp.max(jnp.abs(gw2[:, 0]))), 1e-30) / half
+        hs = jnp.maximum(pmax(jnp.max(gw2[:, 1])), 1e-30) \
             / max(1, cfg.quant_bins)
         if cfg.stochastic and quant_key is not None:
             k = quant_key
@@ -536,91 +549,221 @@ def _grow_compact_impl(cfg: GrowConfig,
             u = jax.random.uniform(k, (n, 2), dtype)
         else:
             u = jnp.full((n, 2), 0.5, dtype)
-        gq = jnp.clip(jnp.floor(gw3[:, 0] / gs + u[:, 0]), -127, 127)
-        hq = jnp.clip(jnp.floor(gw3[:, 1] / hs + u[:, 1]), 0, 127)
-        wq = (w > 0)
-        gw3_q = jnp.stack([gq, hq, wq.astype(dtype)],
-                          axis=-1).astype(jnp.int8)
-        scale3 = jnp.stack([gs, hs, jnp.asarray(1.0, dtype)])
+        gq = jnp.clip(jnp.floor(gw2[:, 0] / gs + u[:, 0]), -127, 127)
+        hq = jnp.clip(jnp.floor(gw2[:, 1] / hs + u[:, 1]), 0, 127)
+        gw2_q = jnp.stack([gq, hq], axis=-1).astype(jnp.int8)
+        scale2 = jnp.stack([gs, hs])
 
     def hist_f(h):
         """int32 histogram -> float stats for split search."""
         if quant:
-            return h.astype(dtype) * scale3[None, None, :]
+            return h.astype(dtype) * scale2[None, None, :]
         return h
 
-    def bucket_idx(size):
-        return jnp.clip(jnp.sum(size > sizes_arr), 0, len(sizes) - 1)
+    # The bin matrix and payload are PHYSICALLY re-ordered on every split
+    # so that each leaf's rows are contiguous. All ordered arrays carry K
+    # rows of padding so chunk slices/updates never clamp at the end;
+    # garbage lands in (and is read from) the pad region and is masked.
+    C = 2
+    iota_k = jnp.arange(K)
 
-    def make_part(S):
-        def br(order, start, cnt, f, t, dl, isc, cm, lazy_used):
-            start_c = jnp.clip(start, 0, n - S)
-            rel = start - start_c
-            idx = lax.dynamic_slice(order, (start_c,), (S,))
-            col_full = lax.dynamic_index_in_dim(
-                bins_T, f, axis=0, keepdims=False)
-            col = col_full[idx].astype(jnp.int32)
-            nanb = feat_nan_bin[f]
-            gl_num = jnp.where((nanb >= 0) & (col == nanb), dl, col <= t)
-            gl = jnp.where(isc, cm[col], gl_num)
-            pos = jnp.arange(S)
-            inp = (pos >= rel) & (pos < rel + cnt)
-            # stable 4-way key: rows before/after the leaf's range keep
-            # their positions; in-range rows split left(1) / right(2)
-            key = jnp.where(inp, jnp.where(gl, 1, 2),
-                            jnp.where(pos < rel, 0, 3))
-            perm = jnp.argsort(key, stable=True)
-            order2 = lax.dynamic_update_slice(order, idx[perm], (start_c,))
-            n_left = jnp.sum((inp & gl).astype(jnp.int32))
-            if cegb:
-                ib = inbag[idx]
-                n_left_ib = jnp.sum((inp & gl & ib).astype(jnp.int32))
-                n_ib = jnp.sum((inp & ib).astype(jnp.int32))
-            else:
-                n_left_ib = n_ib = jnp.asarray(0, jnp.int32)
-            if cegb_lazy:
-                # the split acquires feature f for every in-bag row in the
-                # leaf (UpdateLeafBestSplits' InsertBitset loop over the
-                # bagged partition)
-                lazy_used = lazy_used.at[idx, f].max(inp & ib)
-            return order2, n_left, n_left_ib, n_ib, lazy_used
-        return br
+    def window_chunks(cnt):
+        return lax.div(cnt + (K - 1), jnp.asarray(K, cnt.dtype))
 
-    def make_hist(S):
-        def br(order, start, cnt, lazy_used):
-            start_c = jnp.clip(start, 0, n - S)
-            rel = start - start_c
-            idx = lax.dynamic_slice(order, (start_c,), (S,))
-            pos = jnp.arange(S)
-            inp = (pos >= rel) & (pos < rel + cnt)
-            rows = jnp.take(bins_rm, idx, axis=0)
+    has_cat = feat_is_cat is not None
+    bin_dt = bins_T.dtype
+    pack_w = 4 if bin_dt == jnp.uint8 else 2      # bin cols per u32 word
+    Fp = -(-F // pack_w) * pack_w
+    NW = Fp // pack_w                             # u32 words per row
+
+    def chunk_goleft(blk_b, f, t, dl, isc, cm):
+        """go-left decision for one chunk — all vector ops (a cm[col]
+        table gather would serialize per element on TPU)."""
+        fsel = jnp.arange(F) == f
+        col = jnp.max(jnp.where(fsel[None, :], blk_b, 0),
+                      axis=1).astype(jnp.int32)
+        nanb = feat_nan_bin[f]
+        gl = jnp.where((nanb >= 0) & (col == nanb), dl, col <= t)
+        if has_cat:
+            cm_col = jnp.any((col[:, None] == jnp.arange(B)[None, :])
+                             & cm[None, :], axis=1)
+            gl = jnp.where(isc, cm_col, gl)
+        return gl
+
+    def _pack_bins(blk_b):
+        """[K, F] u8/u16 -> NW u32 columns (bitcast along the contiguous
+        minor axis; no strided column extraction)."""
+        if Fp != F:
+            blk_b = jnp.pad(blk_b, ((0, 0), (0, Fp - F)))
+        w32 = lax.bitcast_convert_type(blk_b.reshape(K, NW, pack_w),
+                                       jnp.uint32)
+        return tuple(w32[:, i] for i in range(NW))
+
+    def _unpack_bins(cols):
+        w32 = jnp.stack(cols, axis=1)                     # [K, NW]
+        u = lax.bitcast_convert_type(w32, bin_dt)         # [K, NW, pack_w]
+        return u.reshape(K, Fp)[:, :F]
+
+    def rot(a, s):
+        """a shifted so that out[j] = a[j - (K - s)] — dynamic roll via
+        self-concatenation (vectorized; no per-element gather)."""
+        if a.ndim == 2:
+            return lax.dynamic_slice(jnp.concatenate([a, a], axis=0),
+                                     (s, 0), (K, a.shape[1]))
+        return lax.dynamic_slice(jnp.concatenate([a, a]), (s,), (K,))
+
+    def part_apply(bins_ord, pay_ord, ib_ord, order, lazy_used, scratch,
+                   start, cnt, f, t, dl, isc, cm):
+        """Stable two-way window compaction + smaller-child histogram,
+        streaming K-row chunks.
+
+        Pass B sorts each chunk by a stable (side, position) key — the
+        TPU's one fast data-movement primitive (a vectorized sorting
+        network; gathers/scatters serialize per element) — and appends
+        the left/right runs to two scratch windows with telescoping
+        full-chunk writes (each write's garbage tail is overwritten by
+        the next; final tails land in scratch padding). Pass C merges
+        scratchL[0, n_left) ++ scratchR[0, n_right) back over the
+        window, and accumulates the smaller child's histogram from the
+        merged chunks on the way through (one streaming pass serves
+        both). The CUDA analog is GenDataToLeftBitVector + prefix-sum
+        compaction (cuda_data_partition.cu) + ConstructHistogramForLeaf
+        (cuda_histogram_constructor.cu)."""
+        sbL, spL, siL, soL, sbR, spR, siR, soR = scratch
+        zero = jnp.asarray(0, jnp.int32)
+
+        def body_b(c, carry):
+            (sbL, spL, siL, soL, sbR, spR, siR, soR, lazy_used,
+             l_off, r_off, nlib, nib) = carry
+            pos0 = start + c * K
+            blk_b = lax.dynamic_slice(bins_ord, (pos0, 0), (K, F))
+            blk_p = lax.dynamic_slice(pay_ord, (pos0, 0), (K, C))
+            blk_i = lax.dynamic_slice(ib_ord, (pos0,), (K,))
+            blk_o = lax.dynamic_slice(order, (pos0,), (K,))
+            gl = chunk_goleft(blk_b, f, t, dl, isc, cm)
+            valid = iota_k < jnp.clip(cnt - c * K, 0, K)
+            vl = valid & gl
+            l_c = jnp.sum(vl.astype(jnp.int32))
+            r_c = jnp.sum((valid & ~gl).astype(jnp.int32))
+            nlib += jnp.sum((vl & blk_i).astype(jnp.int32))
+            nib += jnp.sum((valid & blk_i).astype(jnp.int32))
+            # stable in-chunk partition: one variadic sort moving all
+            # row data by a (side, position) key
+            side = jnp.where(vl, 0, jnp.where(valid, 1, 2))
+            key = side * K + iota_k
+            ops = lax.sort((key,) + _pack_bins(blk_b)
+                           + (blk_p[:, 0], blk_p[:, 1], blk_i, blk_o),
+                           num_keys=1)
+            pb = _unpack_bins(ops[1:1 + NW])
+            pp = jnp.stack(ops[1 + NW:3 + NW], axis=1)
+            pi = ops[3 + NW]
+            po = ops[4 + NW]
+            # rights start at row l_c; align them to 0 for the R append
+            rK = K - l_c
+            sbL = lax.dynamic_update_slice(sbL, pb, (l_off, 0))
+            sbR = lax.dynamic_update_slice(sbR, rot(pb, K - rK), (r_off, 0))
+            spL = lax.dynamic_update_slice(spL, pp, (l_off, 0))
+            spR = lax.dynamic_update_slice(spR, rot(pp, K - rK), (r_off, 0))
+            siL = lax.dynamic_update_slice(siL, pi, (l_off,))
+            siR = lax.dynamic_update_slice(siR, rot(pi, K - rK), (r_off,))
+            soL = lax.dynamic_update_slice(soL, po, (l_off,))
+            soR = lax.dynamic_update_slice(soR, rot(po, K - rK), (r_off,))
             if cegb_lazy:
-                used_rows = jnp.take(lazy_used, idx, axis=0)  # [S, F]
-                nu = jnp.sum((inp & inbag[idx])[:, None] & ~used_rows,
-                             axis=0).astype(dtype)
-            else:
-                nu = jnp.zeros((F,), dtype)
+                # the split acquires feature f for every in-bag row in
+                # the leaf (UpdateLeafBestSplits' InsertBitset loop over
+                # the bagged partition)
+                lazy_used = lazy_used.at[blk_o, f].max(valid & blk_i)
+            return (sbL, spL, siL, soL, sbR, spR, siR, soR, lazy_used,
+                    l_off + l_c, r_off + r_c, nlib, nib)
+
+        (sbL, spL, siL, soL, sbR, spR, siR, soR, lazy_used, n_left, _,
+         n_left_ib, n_ib) = lax.fori_loop(
+            0, window_chunks(cnt), body_b,
+            (sbL, spL, siL, soL, sbR, spR, siR, soR, lazy_used,
+             zero, zero, zero, zero))
+
+        # exact global in-bag child counts replace the search-time
+        # hessian-ratio estimates (SplitInner update_cnt,
+        # serial_tree_learner.cpp:789-791); "smaller" is decided on
+        # GLOBAL counts so every shard histograms the same side
+        # (SyncUpGlobalBestSplit determinism)
+        nl_ex = psum(n_left_ib).astype(dtype)
+        nr_ex = psum(n_ib - n_left_ib).astype(dtype)
+        left_smaller = nl_ex <= nr_ex
+        s_lo = jnp.where(left_smaller, 0, n_left)
+        s_hi_end = jnp.where(left_smaller, n_left, cnt)
+
+        def merge_piece(arrL, arrR, c):
+            off = c * K
+            shift = jnp.clip(n_left - off, 0, K)
+            r0 = jnp.clip(off - n_left, 0, n)
+            if arrL.ndim == 2:
+                cL = lax.dynamic_slice(arrL, (off, 0), (K, arrL.shape[1]))
+                cR = rot(lax.dynamic_slice(arrR, (r0, 0),
+                                           (K, arrL.shape[1])), K - shift)
+                return jnp.where((iota_k < shift)[:, None], cL, cR)
+            cL = lax.dynamic_slice(arrL, (off,), (K,))
+            cR = rot(lax.dynamic_slice(arrR, (r0,), (K,)), K - shift)
+            return jnp.where(iota_k < shift, cL, cR)
+
+        def write(arr, piece, c):
+            off = c * K
+            m = jnp.clip(cnt - off, 0, K)
+            w = start + off
+            if arr.ndim == 2:
+                cur = lax.dynamic_slice(arr, (w, 0), (K, arr.shape[1]))
+                out = jnp.where((iota_k < m)[:, None], piece, cur)
+                return lax.dynamic_update_slice(arr, out, (w, 0))
+            cur = lax.dynamic_slice(arr, (w,), (K,))
+            out = jnp.where(iota_k < m, piece, cur)
+            return lax.dynamic_update_slice(arr, out, (w,))
+
+        acc0 = jnp.zeros((F, B, C), jnp.int32 if quant else dtype)
+
+        def body_c(c, carry):
+            bins_ord, pay_ord, ib_ord, order, hist, nu = carry
+            pb = merge_piece(sbL, sbR, c)
+            pp = merge_piece(spL, spR, c)
+            pi = merge_piece(siL, siR, c)
+            po = merge_piece(soL, soR, c)
+            # smaller-child histogram from the merged rows, on the way
+            # through (saves a third streaming pass over the window)
+            gpos = c * K + iota_k
+            hmask = (gpos >= s_lo) & (gpos < s_hi_end)
+            if cegb_lazy:
+                used_rows = jnp.take(lazy_used, po, axis=0)     # [K, F]
+                nu = nu + jnp.sum((hmask & pi)[:, None] & ~used_rows,
+                                  axis=0).astype(dtype)
             if quant:
-                pay = jnp.take(gw3_q, idx, axis=0) \
-                    * inp[:, None].astype(jnp.int8)
-                return hist_from_rows_int(rows, pay, B, hmethod), nu
-            pay = jnp.take(gw3, idx, axis=0) * inp[:, None].astype(dtype)
-            return hist_from_rows(rows, pay, B, hmethod), nu
-        return br
+                hp = pp * hmask[:, None].astype(jnp.int8)
+                hist = hist + hist_from_rows_int(pb, hp, B, hmethod)
+            else:
+                hp = pp * hmask[:, None].astype(dtype)
+                hist = hist + hist_from_rows(pb, hp, B, hmethod,
+                                             cfg.hist_precision)
+            return (write(bins_ord, pb, c), write(pay_ord, pp, c),
+                    write(ib_ord, pi, c), write(order, po, c), hist, nu)
 
-    part_branches = [make_part(S) for S in sizes]
-    hist_branches = [make_hist(S) for S in sizes]
+        bins_ord, pay_ord, ib_ord, order, small_hist, small_nu = \
+            lax.fori_loop(0, window_chunks(cnt), body_c,
+                          (bins_ord, pay_ord, ib_ord, order, acc0,
+                           jnp.zeros((F,), dtype)))
+        scratch = (sbL, spL, siL, soL, sbR, spR, siR, soR)
+        return (bins_ord, pay_ord, ib_ord, order, lazy_used, scratch,
+                n_left, nl_ex, nr_ex, left_smaller, psum(small_hist),
+                small_nu)
 
     # ---- root ----
+    total_c = psum(jnp.sum(inbag.astype(dtype)))
     if quant:
-        root_hist = psum(hist_from_rows_int(bins_rm, gw3_q, B, hmethod))
+        root_hist = psum(hist_from_rows_int(bins_rm, gw2_q, B, hmethod))
         sums = hist_f(root_hist)[0].sum(axis=0)  # every row hits feature 0
-        total_g, total_h, total_c = sums[0], sums[1], sums[2]
+        total_g, total_h = sums[0], sums[1]
     else:
-        total_g = psum(jnp.sum(gw3[:, 0]))
-        total_h = psum(jnp.sum(gw3[:, 1]))
-        total_c = psum(jnp.sum(gw3[:, 2]))
-        root_hist = psum(hist_from_rows(bins_rm, gw3, B, hmethod))
+        total_g = psum(jnp.sum(gw2[:, 0]))
+        total_h = psum(jnp.sum(gw2[:, 1]))
+        root_hist = psum(hist_from_rows(bins_rm, gw2, B, hmethod,
+                                        cfg.hist_precision))
 
     tree = _init_tree(L, B, dtype)
     tree = tree._replace(
@@ -643,18 +786,25 @@ def _grow_compact_impl(cfg: GrowConfig,
             lazy_used = jnp.zeros((1, 1), jnp.bool_)
             root_nu = jnp.zeros((F,), dtype)
         lazy_nu = jnp.zeros((L, F), dtype).at[0].set(root_nu)
-        root_ib = jnp.sum(inbag.astype(jnp.int32))
-        leaf_ib = jnp.zeros((L,), jnp.int32).at[0].set(root_ib)
-        cegb_state = (coupled_used, lazy_used, lazy_nu, leaf_ib)
-        root_pen = cegb_penalty(root_ib, coupled_used, root_nu)
+        cegb_state = (coupled_used, lazy_used, lazy_nu)
+        root_pen = cegb_penalty(total_c, coupled_used, root_nu)
     best = best.store(0, best_for(hist_f(root_hist), total_g, total_h,
                                   total_c, root_mask, root_pen),
                       jnp.asarray(True))
-    hists = jnp.zeros((L, F, B, 3),
+    hists = jnp.zeros((L, F, B, 2),
                       jnp.int32 if quant else dtype).at[0].set(root_hist)
+    pay0 = gw2_q if quant else gw2
+    scratch0 = (jnp.zeros((n + K, F), bins_rm.dtype),
+                jnp.zeros((n + K, C), pay0.dtype),
+                jnp.zeros((n + K,), jnp.bool_),
+                jnp.zeros((n + K,), jnp.int32)) * 2
     state = _CompactState(
         tree=tree, best=best, hists=hists,
-        order=jnp.arange(n, dtype=jnp.int32),
+        bins_ord=jnp.pad(bins_rm, ((0, K), (0, 0))),
+        pay_ord=jnp.pad(pay0, ((0, K), (0, 0))),
+        ib_ord=jnp.pad(inbag, (0, K)),
+        order=jnp.pad(jnp.arange(n, dtype=jnp.int32), (0, K)),
+        scratch=scratch0,
         leaf_begin=jnp.zeros((L,), jnp.int32),
         leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(n),
         branch=jnp.zeros((L, F), jnp.bool_),
@@ -668,38 +818,33 @@ def _grow_compact_impl(cfg: GrowConfig,
 
     def do_split(state: _CompactState,
                  leaf_override=None) -> _CompactState:
-        (tree, best, hists, order, lbegin, lcount, branch, ns,
-         cegb_st) = state
+        (tree, best, hists, bins_ord, pay_ord, ib_ord, order, _scr,
+         lbegin, lcount, branch, ns, cegb_st) = state
         leaf = jnp.argmax(best.gain).astype(jnp.int32) \
             if leaf_override is None else leaf_override
         R = ns + 1
         start = lbegin[leaf]
         cnt = lcount[leaf]
         f_split = best.feature[leaf]
+        t_bin = best.threshold_bin[leaf]
+        dl = best.default_left[leaf]
+        isc = best.is_cat[leaf]
+        cm = best.cat_mask[leaf]
         lazy_arr = cegb_st[1] if cegb else jnp.zeros((1, 1), jnp.bool_)
 
-        # -- partition the leaf's range (DataPartition::Split analog) --
-        order, n_left, n_left_ib, n_ib, lazy_arr = lax.switch(
-            bucket_idx(cnt), part_branches, order, start, cnt,
-            f_split, best.threshold_bin[leaf],
-            best.default_left[leaf], best.is_cat[leaf],
-            best.cat_mask[leaf], lazy_arr)
+        # -- partition the leaf's range (DataPartition::Split analog) +
+        # smaller-child histogram, fused into the same streaming pass --
+        (bins_ord, pay_ord, ib_ord, order, lazy_arr, scratch, n_left,
+         nl_ex, nr_ex, left_smaller, small_hist, small_nu) = part_apply(
+            bins_ord, pay_ord, ib_ord, order, lazy_arr, state.scratch,
+            start, cnt, f_split, t_bin, dl, isc, cm)
         lbegin = lbegin.at[R].set(start + n_left)
         lcount = lcount.at[leaf].set(n_left).at[R].set(cnt - n_left)
 
         new_depth = tree.leaf_depth[leaf] + 1
-        tree = _apply_split_to_tree(tree, best, leaf, R, ns, p)
+        tree = _apply_split_to_tree(tree, best, leaf, R, ns, p,
+                                    nl_ex, nr_ex)
 
-        # -- histogram the smaller child; sibling by subtraction.
-        # "smaller" is decided on GLOBAL weighted counts so every shard
-        # histograms the same side (SyncUpGlobalBestSplit determinism).
-        left_smaller = best.left_count[leaf] <= best.right_count[leaf]
-        s_start = jnp.where(left_smaller, start, start + n_left)
-        s_cnt = jnp.where(left_smaller, n_left, cnt - n_left)
-        small_hist, small_nu = lax.switch(
-            bucket_idx(s_cnt), hist_branches, order, s_start, s_cnt,
-            lazy_arr)
-        small_hist = psum(small_hist)
         parent_hist = hists[leaf]
         big_hist = subtract_histogram(parent_hist, small_hist)
         left_hist = jnp.where(left_smaller, small_hist, big_hist)
@@ -715,7 +860,7 @@ def _grow_compact_impl(cfg: GrowConfig,
             child_mask = allowed_features(nb)
         pen_l = pen_r = None
         if cegb:
-            coupled_used, _, lazy_nu, leaf_ib = cegb_st
+            coupled_used, _, lazy_nu = cegb_st
             first_use = ~coupled_used[f_split] & (pen_coupled[f_split] > 0)
             coupled_used = coupled_used | (jnp.arange(F) == f_split)
             # parent rows acquired f_split during partition; counts for
@@ -725,16 +870,14 @@ def _grow_compact_impl(cfg: GrowConfig,
             left_nu = jnp.where(left_smaller, small_nu, big_nu)
             right_nu = jnp.where(left_smaller, big_nu, small_nu)
             lazy_nu = lazy_nu.at[leaf].set(left_nu).at[R].set(right_nu)
-            leaf_ib = leaf_ib.at[leaf].set(n_left_ib) \
-                             .at[R].set(n_ib - n_left_ib)
-            cegb_st = (coupled_used, lazy_arr, lazy_nu, leaf_ib)
-            pen_l = cegb_penalty(n_left_ib, coupled_used, left_nu)
-            pen_r = cegb_penalty(n_ib - n_left_ib, coupled_used, right_nu)
+            cegb_st = (coupled_used, lazy_arr, lazy_nu)
+            pen_l = cegb_penalty(nl_ex, coupled_used, left_nu)
+            pen_r = cegb_penalty(nr_ex, coupled_used, right_nu)
         rl = best_for(hist_f(left_hist), best.left_sum_g[leaf],
-                      best.left_sum_h[leaf], best.left_count[leaf],
+                      best.left_sum_h[leaf], nl_ex,
                       child_mask, pen_l)
         rr = best_for(hist_f(right_hist), best.right_sum_g[leaf],
-                      best.right_sum_h[leaf], best.right_count[leaf],
+                      best.right_sum_h[leaf], nr_ex,
                       child_mask, pen_r)
         best = best.store(leaf, rl, can_go_deeper)
         best = best.store(R, rr, can_go_deeper)
@@ -747,13 +890,13 @@ def _grow_compact_impl(cfg: GrowConfig,
             # cost_effective_gradient_boosting.hpp:100-124); we hold the
             # per-leaf histograms in HBM, so an exact re-search of every
             # leaf under the updated penalty is the same result.
-            coupled_used, _, lazy_nu, leaf_ib = cegb_st
+            coupled_used, _, lazy_nu = cegb_st
 
             def research(best):
-                hf = jax.vmap(hist_f)(hists)              # [L, F, B, 3]
-                sums = hf[:, 0].sum(axis=1)               # [L, 3]
+                hf = jax.vmap(hist_f)(hists)              # [L, F, B, 2]
+                sums = hf[:, 0].sum(axis=1)               # [L, 2]
                 pens = jax.vmap(cegb_penalty,
-                                in_axes=(0, None, 0))(leaf_ib,
+                                in_axes=(0, None, 0))(tree.leaf_count,
                                                       coupled_used,
                                                       lazy_nu)
                 masks = None if interaction_groups is None \
@@ -761,7 +904,8 @@ def _grow_compact_impl(cfg: GrowConfig,
                 r = jax.vmap(best_for, in_axes=(0, 0, 0, 0,
                                                 None if masks is None
                                                 else 0, 0))(
-                    hf, sums[:, 0], sums[:, 1], sums[:, 2], masks, pens)
+                    hf, sums[:, 0], sums[:, 1], tree.leaf_count,
+                    masks, pens)
                 if cfg.max_depth > 0:
                     allowed = tree.leaf_depth < cfg.max_depth
                 else:
@@ -780,23 +924,28 @@ def _grow_compact_impl(cfg: GrowConfig,
 
             best = lax.cond(first_use, research, lambda b: b, best)
 
-        return _CompactState(tree=tree, best=best, hists=hists, order=order,
+        return _CompactState(tree=tree, best=best, hists=hists,
+                             bins_ord=bins_ord, pay_ord=pay_ord,
+                             ib_ord=ib_ord, order=order, scratch=scratch,
                              leaf_begin=lbegin, leaf_count=lcount,
                              branch=branch, num_splits=ns + 1,
                              cegb=cegb_st)
 
-    def forced_result(hist, f, t) -> SplitResult:
+    def forced_result(hist, tc, f, t) -> SplitResult:
         """Fixed (feature, bin) split record from a leaf's histogram
         (SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:620).
-        Missing values route right (default_left=False)."""
+        Missing values route right (default_left=False). ``tc`` is the
+        leaf's exact count; child counts are hessian-ratio estimates
+        like the regular search (feature_histogram.hpp:528)."""
         totals = jnp.sum(hist[0], axis=0)          # every row hits feat 0
-        tg, th, tc = totals[0], totals[1], totals[2]
-        h = hist[f]                                # [B, 3]
+        tg, th = totals[0], totals[1]
+        h = hist[f]                                # [B, 2]
         binsb = jnp.arange(B)
         nanb = feat_nan_bin[f]
         sel = (binsb <= t) & ~((binsb == nanb) & (nanb >= 0))
         left = jnp.sum(h * sel[:, None].astype(h.dtype), axis=0)
-        lg, lh, lc = left[0], left[1], left[2]
+        lg, lh = left[0], left[1]
+        lc = jnp.round(lh * tc / jnp.maximum(th, 1e-15))
         rg, rh, rc = tg - lg, th - lh, tc - lc
         gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p) \
             - leaf_gain(tg, th, p)
@@ -811,7 +960,8 @@ def _grow_compact_impl(cfg: GrowConfig,
             right_output=leaf_output(rg, rh, p))
 
     def forced_step(state: _CompactState, leaf, f, t) -> _CompactState:
-        r = forced_result(hist_f(state.hists[leaf]), f, t)
+        r = forced_result(hist_f(state.hists[leaf]),
+                          state.tree.leaf_count[leaf], f, t)
         valid = (r.left_count > 0) & (r.right_count > 0)
         forced_state = state._replace(best=state.best.store(leaf, r,
                                                             jnp.asarray(True)))
@@ -819,25 +969,31 @@ def _grow_compact_impl(cfg: GrowConfig,
                         lambda s: do_split(s, leaf_override=leaf),
                         lambda _: state, forced_state)
 
-    def step(_, state: _CompactState) -> _CompactState:
-        can = jnp.max(state.best.gain) > 0.0
-        return lax.cond(can, do_split, lambda s: s, state)
-
     M = 0
     if forced is not None:
         f_leaf, f_feat, f_bin = forced
         M = min(int(f_leaf.shape[0]), L - 1)
         for i in range(M):
             state = forced_step(state, f_leaf[i], f_feat[i], f_bin[i])
-    state = lax.fori_loop(M, L - 1, step, state)
-    row_leaf = _row_leaf_from_order(state.order, state.leaf_begin,
+
+    # growth loop: a while_loop with the stop condition in cond_fn (the
+    # reference's early break, serial_tree_learner.cpp:225) — unlike a
+    # fori_loop of lax.conds, the body always does real work and XLA
+    # aliases the carried buffers in place instead of copying them
+    # through conditional branches.
+    def can_grow(state: _CompactState):
+        return (state.num_splits < L - 1) \
+            & (jnp.max(state.best.gain) > 0.0)
+
+    state = lax.while_loop(can_grow, do_split, state)
+    row_leaf = _row_leaf_from_order(state.order[:n], state.leaf_begin,
                                     state.leaf_count, n, L)
     tree = state.tree
     if quant and cfg.renew_leaf:
         # RenewIntGradTreeOutput (gradient_discretizer.hpp): replace the
         # quantized leaf outputs with exact float sums per leaf.
-        sg = psum(jax.ops.segment_sum(gw3[:, 0], row_leaf, num_segments=L))
-        sh = psum(jax.ops.segment_sum(gw3[:, 1], row_leaf, num_segments=L))
+        sg = psum(jax.ops.segment_sum(gw2[:, 0], row_leaf, num_segments=L))
+        sh = psum(jax.ops.segment_sum(gw2[:, 1], row_leaf, num_segments=L))
         newv = leaf_output(sg, sh, p)
         lv = jnp.where(jnp.arange(L) < tree.num_leaves, newv,
                        tree.leaf_value)

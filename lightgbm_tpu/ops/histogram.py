@@ -2,17 +2,32 @@
 
 Re-design of the reference's histogram kernels
 (/root/reference/src/io/dense_bin.hpp:99 ``ConstructHistogramInner``,
-src/treelearner/cuda/cuda_histogram_constructor.cu:18): per-row (grad, hess,
-count) scatter-add into ``[num_features, num_bins, 3]`` accumulators.
+src/treelearner/cuda/cuda_histogram_constructor.cu:18): per-row (grad, hess)
+scatter-add into ``[num_features, num_bins, 2]`` accumulators.
 
 Design notes (TPU-first):
+- Histogram entries are (sum_grad, sum_hess) pairs ONLY — exactly like the
+  reference (``kHistEntrySize = 2 * sizeof(hist_t)``, bin.h:39). Per-bin
+  data counts are *estimated* downstream from the hessian ratio
+  ``cnt = RoundInt(hess * num_data / sum_hessian)``
+  (feature_histogram.hpp:528,543), so no count channel is accumulated.
 - The bin matrix is stored transposed ``[F, n]`` (column-major, like the
   reference's DenseBin) so one feature's bins are a contiguous vector.
 - The fast path is the *nibble decomposition*: a bin index b = 16*hi + lo
   turns the histogram into HI^T @ (LO * payload) — dense batched matmuls
   that ride the MXU instead of scatter hardware (which XLA serializes on
-  TPU). Float payloads accumulate in f32 at HIGHEST precision; quantized
-  int8 payloads accumulate exactly in int32 on the int MXU.
+  TPU). With the 2-channel payload an 8-feature pack is a [128, S] x
+  [S, 256] matmul — both dims exact multiples of the 128-lane MXU tile.
+- Precision: the default float path runs single-pass bf16-input/f32-accum
+  matmuls (the MXU's native mode). The reference's GPU learner documents
+  AUC parity with single-precision histograms at 255 bins
+  (docs/GPU-Performance.rst:134-158); ``precision="high"|"highest"``
+  (3/6-pass emulation) are available for stricter accumulation.
+- Quantized int8 payloads are EXACT: int8 values are exactly
+  representable in bf16, products against a {0,1} one-hot are exact, and
+  f32 accumulation of a <=8192-row block is exact (|sum| <= 8192*127 <
+  2^24); each block is converted to int32 before the cross-block sum, so
+  the result equals true int32 accumulation at full MXU speed.
 - There is no most-frequent-bin omission / ``FixHistogram`` reconstruction
   (dataset.h:760): every bin is accumulated directly, which on TPU costs
   nothing extra and removes a cross-rank reconstruction step.
@@ -31,36 +46,41 @@ __all__ = ["build_histogram", "subtract_histogram", "hist_from_rows",
            "hist_from_rows_int", "PACK"]
 
 PACK = 8          # features per MXU pack (PACK * 16 = 128 lanes)
-ROW_BLOCK = 8192  # rows per accumulation block (bounds one-hot residency)
+ROW_BLOCK = 8192  # rows per accumulation block (bounds one-hot residency
+                  # AND keeps int-as-bf16 block sums exact: 8192*127 < 2^24)
+
+_PRECISIONS = {
+    "default": None,
+    "high": lax.Precision.HIGH,
+    "highest": lax.Precision.HIGHEST,
+}
 
 
 def _nibble_hist_block(rows: jnp.ndarray, payload: jnp.ndarray,
-                       s_hi: int, accum_dtype) -> jnp.ndarray:
+                       s_hi: int, precision, int_exact: bool) -> jnp.ndarray:
     """One row-block of the nibble-decomposed MXU histogram.
 
     ``hist[f, b] = sum_r [bins[r,f]==b] * payload[r]`` with ``b = 16*hi+lo``
     factors into ``sum_r HI[r, f*s_hi+hi] * LO[r, f*16+lo] * payload[r]``:
-    a dense [x, S] x [S, y*c] batched matmul over PACK-feature groups —
-    the MXU replacement for the CUDA shared-memory scatter-add
+    a dense [128, S] x [S, 256] matmul per PACK-feature group — the MXU
+    replacement for the CUDA shared-memory scatter-add
     (/root/reference/src/treelearner/cuda/cuda_histogram_constructor.cu:18).
     Cross-feature (p != q) blocks of the product are computed and
     discarded; the MXU does them for free within the 128-lane tile.
 
-    Float payloads run at HIGHEST precision (true f32 accumulation; the
-    bf16 MXU default would corrupt the count channel). int8 payloads
-    accumulate exactly in int32 — the quantized-gradient path
-    (gradient_discretizer.hpp; cuda_histogram_constructor.cu:250-448).
-
     Args:
       rows: ``[S, npacks, PACK]`` int32 bin values.
-      payload: ``[S, C]`` float or int8 channels (g, h, count-weight).
+      payload: ``[S, C]`` float or int8 channels (grad, hess).
     Returns:
-      ``[npacks, PACK, s_hi * 16, C]`` partial histograms.
+      ``[npacks, PACK, s_hi * 16, C]`` partial histograms, f32 (exact
+      integers when ``int_exact``).
     """
     S, npacks, P = rows.shape
     C = payload.shape[-1]
-    onehot_dtype = payload.dtype
-    is_int = jnp.issubdtype(accum_dtype, jnp.integer)
+    onehot_dtype = jnp.bfloat16 if int_exact else payload.dtype
+    if int_exact:
+        payload = payload.astype(jnp.bfloat16)
+        precision = None
     hi = rows // 16
     lo = rows & 15
     HI = (hi[..., None] == jnp.arange(s_hi)).astype(onehot_dtype)
@@ -70,8 +90,8 @@ def _nibble_hist_block(rows: jnp.ndarray, payload: jnp.ndarray,
         "snx,snyc->nxyc",
         HI.reshape(S, npacks, P * s_hi),
         LOC.reshape(S, npacks, P * 16, C),
-        preferred_element_type=accum_dtype,
-        precision=None if is_int else lax.Precision.HIGHEST)
+        preferred_element_type=jnp.float32,
+        precision=precision)
     d = jnp.diagonal(out.reshape(npacks, P, s_hi, P, 16, C),
                      axis1=1, axis2=3)                    # [np,hi,16,C,P]
     return d.transpose(0, 4, 1, 2, 3).reshape(npacks, P, s_hi * 16, C)
@@ -79,9 +99,10 @@ def _nibble_hist_block(rows: jnp.ndarray, payload: jnp.ndarray,
 
 def _hist_from_rows_impl(rows: jnp.ndarray, payload: jnp.ndarray,
                          num_bins: int, method: str,
-                         accum_dtype) -> jnp.ndarray:
+                         accum_dtype, precision) -> jnp.ndarray:
     if method == "scatter":
         return _hist_scatter(rows.T, payload.astype(accum_dtype), num_bins)
+    int_exact = jnp.issubdtype(accum_dtype, jnp.integer)
     S, F = rows.shape
     C = payload.shape[-1]
     s_hi = -(-num_bins // 16)
@@ -92,8 +113,12 @@ def _hist_from_rows_impl(rows: jnp.ndarray, payload: jnp.ndarray,
     npacks = Fp // PACK
     rows = rows.astype(jnp.int32).reshape(S, npacks, PACK)
 
+    def finish(block):
+        return block.astype(accum_dtype) if int_exact else block
+
     if S <= ROW_BLOCK:
-        h = _nibble_hist_block(rows, payload, s_hi, accum_dtype)
+        h = finish(_nibble_hist_block(rows, payload, s_hi, precision,
+                                      int_exact))
     else:
         nblk = -(-S // ROW_BLOCK)
         pad = nblk * ROW_BLOCK - S
@@ -105,7 +130,8 @@ def _hist_from_rows_impl(rows: jnp.ndarray, payload: jnp.ndarray,
 
         def body(acc, xs):
             r, p = xs
-            return acc + _nibble_hist_block(r, p, s_hi, accum_dtype), None
+            blk = _nibble_hist_block(r, p, s_hi, precision, int_exact)
+            return acc + finish(blk), None
 
         init = jnp.zeros((npacks, PACK, s_hi * 16, C), accum_dtype)
         h, _ = lax.scan(body, init, (rows_b, pay_b))
@@ -114,33 +140,37 @@ def _hist_from_rows_impl(rows: jnp.ndarray, payload: jnp.ndarray,
 
 
 def hist_from_rows(rows: jnp.ndarray, payload: jnp.ndarray,
-                   num_bins: int, method: str = "mxu") -> jnp.ndarray:
+                   num_bins: int, method: str = "mxu",
+                   precision: str = "default") -> jnp.ndarray:
     """Float histogram over a row-block matrix.
 
     Args:
       rows: ``[S, F]`` integer bin matrix (row-major).
-      payload: ``[S, C]`` float per-row channels.
+      payload: ``[S, C]`` float per-row channels (grad, hess).
       num_bins: B.
       method: "mxu" (nibble matmul) or "scatter" (CPU-friendly).
+      precision: matmul pass count — "default" (1-pass bf16/f32-accum),
+        "high" (3-pass), "highest" (6-pass); mxu path only.
     Returns:
       ``[F, B, C]`` histograms (padding features report zeros only if the
       caller masked their payload; callers crop to the true F).
     """
     return _hist_from_rows_impl(rows, payload, num_bins, method,
-                                payload.dtype)
+                                payload.dtype, _PRECISIONS[precision])
 
 
 def hist_from_rows_int(rows: jnp.ndarray, payload: jnp.ndarray,
                        num_bins: int, method: str = "mxu") -> jnp.ndarray:
-    """Quantized histogram: int8 payload, exact int32 accumulation
-    (subtraction-safe)."""
-    return _hist_from_rows_impl(rows, payload, num_bins, method, jnp.int32)
+    """Quantized histogram: int8 payload, exact int32 result
+    (subtraction-safe) via bf16 MXU passes with per-block conversion."""
+    return _hist_from_rows_impl(rows, payload, num_bins, method, jnp.int32,
+                                None)
 
 
-def _hist_mxu(bins_T: jnp.ndarray, gh: jnp.ndarray,
-              num_bins: int) -> jnp.ndarray:
+def _hist_mxu(bins_T: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
+              precision: str = "default") -> jnp.ndarray:
     """Full-pass MXU histogram from the feature-major bin matrix."""
-    return hist_from_rows(bins_T.T, gh, num_bins)
+    return hist_from_rows(bins_T.T, gh, num_bins, precision=precision)
 
 
 def _hist_scatter(bins_T: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
@@ -156,65 +186,31 @@ def _hist_scatter(bins_T: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
     return hists
 
 
-def _hist_onehot(bins_T: jnp.ndarray, gh: jnp.ndarray,
-                 num_bins: int, block: int = 8192) -> jnp.ndarray:
-    """One-hot matmul path: rides the MXU instead of scatter hardware.
-
-    hist[f, b, c] = sum_r onehot(bins[f, r], b) * gh[r, c], computed in
-    row blocks so the one-hot tensor stays small. Superseded by the
-    nibble decomposition (16x fewer padded FLOPs at 256 bins); kept as a
-    cross-check reference.
-    """
-    F, n = bins_T.shape
-    C = gh.shape[-1]
-    pad = (-n) % block
-    if pad:
-        bins_T = jnp.pad(bins_T, ((0, 0), (0, pad)), constant_values=0)
-        gh = jnp.pad(gh, ((0, pad), (0, 0)))
-    nblk = bins_T.shape[1] // block
-    bins_blk = bins_T.reshape(F, nblk, block).transpose(1, 0, 2)
-    gh_blk = gh.reshape(nblk, block, C)
-
-    def body(acc, xs):
-        b, g = xs
-        onehot = jax.nn.one_hot(b, num_bins, dtype=gh.dtype)  # [F, blk, B]
-        acc = acc + jnp.einsum(
-            "frb,rc->fbc", onehot, g,
-            preferred_element_type=gh.dtype,
-            precision=lax.Precision.HIGHEST)
-        return acc, None
-
-    init = jnp.zeros((F, num_bins, C), dtype=gh.dtype)
-    hists, _ = lax.scan(body, init, (bins_blk, gh_blk))
-    return hists
-
-
 def build_histogram(bins_T: jnp.ndarray,
                     grad: jnp.ndarray,
                     hess: jnp.ndarray,
                     row_weight: jnp.ndarray,
                     mask: jnp.ndarray,
                     num_bins: int,
-                    method: str = "scatter") -> jnp.ndarray:
+                    method: str = "scatter",
+                    precision: str = "default") -> jnp.ndarray:
     """Build per-feature histograms for the rows selected by ``mask``.
 
     Args:
       bins_T: ``[F, n]`` integer bin matrix (feature-major).
       grad, hess: ``[n]`` float gradients/hessians.
-      row_weight: ``[n]`` sampling weight (bagging mask / GOSS amplification);
-        contributes the histogram's count channel.
+      row_weight: ``[n]`` sampling weight (bagging mask / GOSS
+        amplification); scales the payload.
       mask: ``[n]`` bool leaf-membership mask.
       num_bins: global max number of bins B.
 
     Returns:
-      ``[F, B, 3]`` float array of (sum_grad, sum_hess, count).
+      ``[F, B, 2]`` float array of (sum_grad, sum_hess).
     """
     m = mask.astype(grad.dtype) * row_weight.astype(grad.dtype)
-    gh = jnp.stack([grad * m, hess * m, m], axis=-1)  # [n, 3]
-    if method == "onehot":
-        return _hist_onehot(bins_T, gh, num_bins)
+    gh = jnp.stack([grad * m, hess * m], axis=-1)  # [n, 2]
     if method == "mxu":
-        return _hist_mxu(bins_T, gh, num_bins)
+        return _hist_mxu(bins_T, gh, num_bins, precision)
     return _hist_scatter(bins_T, gh, num_bins)
 
 

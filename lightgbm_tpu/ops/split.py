@@ -180,8 +180,11 @@ def find_best_split(hist: jnp.ndarray,
     """Find the best (feature, threshold) over a leaf's histograms.
 
     Args:
-      hist: ``[F, B, 3]`` (sum_g, sum_h, count) per feature/bin.
-      parent_g/h/cnt: scalars — the leaf's total stats.
+      hist: ``[F, B, 2]`` (sum_g, sum_h) per feature/bin — histogram
+        entries carry no counts, exactly like the reference
+        (``kHistEntrySize = 2 * sizeof(hist_t)``, bin.h:39).
+      parent_g/h/cnt: scalars — the leaf's total stats (``parent_cnt``
+        is the exact partition count).
       feat_num_bins: ``[F]`` i32 — #bins actually used per feature.
       feat_nan_bin: ``[F]`` i32 — index of the NaN bin, or -1.
       feature_mask: ``[F]`` bool — column-sampling / trivial-feature mask.
@@ -190,10 +193,19 @@ def find_best_split(hist: jnp.ndarray,
         DeltaGain) subtracted from every candidate of that feature.
 
     Returns a scalar SplitResult; ``gain`` is already shifted by the parent
-    gain and min_gain_to_split (so "> 0" means worth splitting).
+    gain and min_gain_to_split (so "> 0" means worth splitting). The
+    returned left/right counts are hessian-ratio estimates
+    ``cnt = round(hess * num_data / sum_hessian)``
+    (feature_histogram.hpp:528,543) — callers holding real partition
+    counts overwrite them (SplitInner, serial_tree_learner.cpp:789).
     """
     F, B, _ = hist.shape
     dtype = hist.dtype
+    # synthesize the per-bin count channel from the hessian ratio, rounded
+    # per bin exactly like the reference's scan accumulates RoundInt(...)
+    cnt_factor = parent_cnt / jnp.maximum(parent_h, K_EPS)
+    hist = jnp.concatenate(
+        [hist, jnp.round(hist[..., 1:2] * cnt_factor)], axis=-1)
     total = jnp.stack([parent_g, parent_h, parent_cnt]).astype(dtype)
 
     has_nan = feat_nan_bin >= 0
