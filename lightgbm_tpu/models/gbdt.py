@@ -31,7 +31,8 @@ from ..ops.grow import GrowConfig, TreeArrays, grow_tree
 from ..ops.predict import predict_leaf_binned
 from ..ops.renew import renew_leaf_values
 from ..ops.split import SplitParams
-from .tree import Tree, tree_from_arrays
+from .tree import (Tree, pack_tree_device, tree_from_arrays,
+                   unpack_tree_host)
 
 __all__ = ["GBDTBooster"]
 
@@ -81,7 +82,9 @@ class GBDTBooster:
         self.objective = objective
         self.K = (objective.num_model_per_iteration
                   if objective is not None else num_model_per_iter)
-        self.models: List[Tree] = []
+        self._models_store: List[Tree] = []
+        self._pending_dev: List[tuple] = []
+        self._nl_async: List = []
         self.iter_ = 0
         self.valid_sets: List[_ValidData] = []
         self._shrinkage = cfg.learning_rate
@@ -229,6 +232,41 @@ class GBDTBooster:
         self._tree_weights: List[float] = []  # per-model weight (DART/RF)
 
     # ------------------------------------------------------------------
+    @property
+    def models(self) -> List[Tree]:
+        """Host Tree objects. Training defers device->host tree
+        materialization (per-iteration fetches would stall the device
+        pipeline; the copies run async) — first access flushes the
+        queue."""
+        self._flush_pending()
+        return self._models_store
+
+    @models.setter
+    def models(self, v) -> None:
+        self._pending_dev = []
+        self._nl_async = []
+        self._models_store = list(v)
+
+    def _flush_pending(self) -> None:
+        if not self._pending_dev:
+            return
+        pending, self._pending_dev = self._pending_dev, []
+        mappers = self.train_set.mappers
+        used = self.train_set.used_feature_indices()
+        for vec, cmask, proto, shrink, bias in pending:
+            host = unpack_tree_host(vec, cmask, proto)
+            tree = tree_from_arrays(host, mappers, used)
+            if int(host.num_leaves) <= 1:
+                # AsConstantTree (gbdt.cpp): a no-growth tree keeps only
+                # the folded bias, unshrunk
+                tree.leaf_value[:] = bias
+            else:
+                tree.apply_shrinkage(shrink)
+                if bias:
+                    tree.leaf_value = tree.leaf_value + bias
+                    tree.internal_value = tree.internal_value + bias
+            self._models_store.append(tree)
+
     def preload_models(self, trees: List[Tree]) -> None:
         """Continue training from an existing model (the reference's
         init_model / num_init_iteration path, gbdt.cpp Init +
@@ -615,6 +653,14 @@ class GBDTBooster:
         cfg = self.cfg
         it = self.iter_
 
+        # deferred-mode no-growth check, one iteration late: the async
+        # copies were started last iteration so this read doesn't stall
+        if self._nl_async:
+            nls = [int(np.asarray(x)) for x in self._nl_async]
+            self._nl_async = []
+            if all(nl <= 1 for nl in nls):
+                return True
+
         # DART: pick and temporarily drop trees (dart.hpp DroppingTrees)
         drop_idx: List[int] = []
         if cfg.boosting == "dart" and self.models:
@@ -688,7 +734,15 @@ class GBDTBooster:
                         self._cegb_lazy_used = lz
                 else:
                     dev_tree, row_leaf = out
-            num_leaves = int(np.asarray(dev_tree.num_leaves))
+            defer = (not self.valid_sets and cfg.boosting == "gbdt"
+                     and not cfg.linear_tree)
+            if defer:
+                # no blocking scalar fetch: the no-growth check runs one
+                # iteration late off an async copy (see top of method);
+                # constant trees are recognized at flush time
+                num_leaves = 2
+            else:
+                num_leaves = int(np.asarray(dev_tree.num_leaves))
             if num_leaves <= 1:
                 # constant tree; carries the boost_from_average bias when
                 # it is the first iteration (gbdt.cpp models_.size() check /
@@ -738,32 +792,59 @@ class GBDTBooster:
                     self.objective.renew_alpha, leaf_values)
                 dev_tree = dev_tree._replace(leaf_value=leaf_values)
 
-            lin = None
-            if cfg.linear_tree:
-                lin = self._fit_linear(
-                    dev_tree, row_leaf, grad[k], hess[k], row_w,
-                    is_first=(len(self.models) < self.K))
-            tree = tree_from_arrays(dev_tree, self.train_set.mappers,
-                                    self.train_set.used_feature_indices())
-            tree.apply_shrinkage(shrinkage)
-            if lin is not None:
-                self._attach_linear(tree, lin, shrinkage)
             fold_now = (cfg.boosting == "rf") or (it == 0 and self._fold_bias)
-            if fold_now and self.init_score[k] != 0.0:
-                # Tree::AddBias: the constant rides inside leaf values so
-                # the model file is self-contained (every tree for rf)
-                tree.leaf_value = tree.leaf_value + self.init_score[k]
-                tree.internal_value = tree.internal_value \
-                    + self.init_score[k]
-                if tree.is_linear and getattr(tree, "leaf_const",
-                                              None) is not None:
-                    # AddBias updates leaf_const too (tree.cpp:222-227)
-                    tree.leaf_const = tree.leaf_const + self.init_score[k]
-            self.models.append(tree)
-            self._tree_weights.append(1.0)
+            bias = float(self.init_score[k]) if fold_now else 0.0
+            lin = None
+            if defer:
+                # Don't stall the device pipeline on a per-iteration
+                # host fetch: pack the tree to one vector, start an
+                # async copy, and materialize the host Tree lazily
+                # (models property). Bias/shrinkage are re-applied at
+                # materialization in the same order as the eager path.
+                vec, cmask = pack_tree_device(dev_tree)
+                try:
+                    vec.copy_to_host_async()
+                    cmask.copy_to_host_async()
+                except AttributeError:  # non-jax arrays (tests/cpu)
+                    pass
+                proto = jax.tree.map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                    dev_tree)
+                self._pending_dev.append((vec, cmask, proto,
+                                          shrinkage, bias))
+                self._tree_weights.append(1.0)
+                self._nl_async.append(dev_tree.num_leaves)
+                tree = None
+            else:
+                if cfg.linear_tree:
+                    lin = self._fit_linear(
+                        dev_tree, row_leaf, grad[k], hess[k], row_w,
+                        is_first=(len(self.models) < self.K))
+                tree = tree_from_arrays(dev_tree, self.train_set.mappers,
+                                        self.train_set.used_feature_indices())
+                tree.apply_shrinkage(shrinkage)
+                if lin is not None:
+                    self._attach_linear(tree, lin, shrinkage)
+                if bias != 0.0:
+                    # Tree::AddBias: the constant rides inside leaf values
+                    # so the model file is self-contained (every tree for
+                    # rf)
+                    tree.leaf_value = tree.leaf_value + bias
+                    tree.internal_value = tree.internal_value + bias
+                    if tree.is_linear and getattr(tree, "leaf_const",
+                                                  None) is not None:
+                        # AddBias updates leaf_const too (tree.cpp:222-227)
+                        tree.leaf_const = tree.leaf_const + bias
+                self.models.append(tree)
+                self._tree_weights.append(1.0)
 
             contrib_raw = lin[2] if lin is not None \
                 else leaf_values[row_leaf]
+            if defer:
+                # a no-growth tree is replaced by a constant at flush
+                # (AsConstantTree, gbdt.cpp): contribute nothing here
+                contrib_raw = jnp.where(dev_tree.num_leaves > 1,
+                                        contrib_raw, 0.0)
             if cfg.boosting == "rf":
                 # running average of unscaled tree outputs (rf.hpp
                 # MultiplyScore m -> UpdateScore -> MultiplyScore 1/(m+1))
@@ -865,6 +946,7 @@ class GBDTBooster:
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:454)."""
+        self._nl_async = []
         if not self.models:
             return
         is_rf = self.cfg.boosting == "rf"

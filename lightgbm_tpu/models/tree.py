@@ -241,14 +241,53 @@ class Tree:
         return t
 
 
+@jax.jit
+def pack_tree_device(t):
+    """Everything except the categorical bitmask as ONE f32 vector
+    (i32 fields are < 2^24 so the cast is lossless): a tree crosses
+    device->host in two transfers instead of one per field."""
+    import jax.numpy as jnp
+    parts = [getattr(t, f) for f in t._fields if f != "split_cat_mask"]
+    vec = jnp.concatenate(
+        [jnp.ravel(p).astype(jnp.float32) for p in parts])
+    return vec, t.split_cat_mask
+
+
+def unpack_tree_host(vec, cmask, proto):
+    """Inverse of pack_tree_device; ``proto`` supplies shapes/dtypes."""
+    vec = np.asarray(vec)
+    fields = {}
+    off = 0
+    for f in proto._fields:
+        if f == "split_cat_mask":
+            continue
+        arr = getattr(proto, f)
+        sz = int(np.prod(arr.shape)) if arr.shape else 1
+        piece = vec[off:off + sz].astype(arr.dtype)
+        fields[f] = piece.reshape(arr.shape) if arr.shape else piece[0]
+        off += sz
+    fields["split_cat_mask"] = np.asarray(cmask)
+    return type(proto)(**fields)
+
+
+def _fetch_tree_host(dev_tree):
+    """Device TreeArrays -> host TreeArrays in two transfers."""
+    if isinstance(getattr(dev_tree, "split_feature", None), np.ndarray):
+        return dev_tree
+    vec, cmask = jax.device_get(pack_tree_device(dev_tree))
+    return unpack_tree_host(vec, cmask, dev_tree)
+
+
 def tree_from_arrays(dev_tree, mappers: Sequence[BinMapper],
                      used_features: Optional[np.ndarray] = None) -> Tree:
     """Convert device TreeArrays (ops/grow.py) to a host Tree, realizing
     bin-space thresholds as real values via the BinMappers."""
-    # one batched device->host fetch for the whole pytree: per-field
+    # ONE device->host fetch for the whole tree: everything except the
+    # categorical bitmask is packed into a single f32 vector on device
+    # (i32 fields are < 2^24 so the cast is lossless); per-field
     # np.asarray would pay a device round-trip per array (a dozen
     # pipeline stalls per boosting iteration)
-    dev_tree = jax.device_get(dev_tree)
+    dev_tree = _fetch_tree_host(dev_tree)
     L = int(np.asarray(dev_tree.num_leaves))
     nn = max(L - 1, 0)
     inner_sf = np.asarray(dev_tree.split_feature)[:nn].astype(np.int32)
