@@ -339,6 +339,8 @@ def _subset_dataset(full: Dataset, idx: np.ndarray,
     sub.weight = None if w is None else np.asarray(w)[idx]
     init = full.get_init_score()
     sub.init_score = None if init is None else np.asarray(init)[idx]
+    pos = full.get_position()
+    sub.position = None if pos is None else np.asarray(pos)[idx]
     qb = full.query_boundaries()
     if qb is not None:
         # reconstruct boundaries for the kept (whole) queries

@@ -654,11 +654,13 @@ class GBDTBooster:
         it = self.iter_
 
         # deferred-mode no-growth check, one iteration late: the async
-        # copies were started last iteration so this read doesn't stall
+        # copies were started last iteration so this read doesn't stall.
+        # Custom gradients always get a fresh attempt (the reference's
+        # TrainOneIterCustom never short-circuits on past iterations).
         if self._nl_async:
             nls = [int(np.asarray(x)) for x in self._nl_async]
             self._nl_async = []
-            if all(nl <= 1 for nl in nls):
+            if custom_grad is None and all(nl <= 1 for nl in nls):
                 return True
 
         # DART: pick and temporarily drop trees (dart.hpp DroppingTrees)
@@ -805,6 +807,7 @@ class GBDTBooster:
                 try:
                     vec.copy_to_host_async()
                     cmask.copy_to_host_async()
+                    dev_tree.num_leaves.copy_to_host_async()
                 except AttributeError:  # non-jax arrays (tests/cpu)
                     pass
                 proto = jax.tree.map(

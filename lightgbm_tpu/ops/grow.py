@@ -959,22 +959,27 @@ def _grow_compact_impl(cfg: GrowConfig,
             left_output=leaf_output(lg, lh, p),
             right_output=leaf_output(rg, rh, p))
 
-    def forced_step(state: _CompactState, leaf, f, t) -> _CompactState:
+    def forced_step(state: _CompactState, ok, leaf, f, t):
+        """One forced split. An invalid forced split aborts ALL
+        remaining ones (abort_last_forced_split,
+        serial_tree_learner.cpp:695-699), not just itself."""
         r = forced_result(hist_f(state.hists[leaf]),
                           state.tree.leaf_count[leaf], f, t)
-        valid = (r.left_count > 0) & (r.right_count > 0)
+        valid = ok & (r.left_count > 0) & (r.right_count > 0)
         forced_state = state._replace(best=state.best.store(leaf, r,
                                                             jnp.asarray(True)))
         return lax.cond(valid,
                         lambda s: do_split(s, leaf_override=leaf),
-                        lambda _: state, forced_state)
+                        lambda _: state, forced_state), valid
 
     M = 0
     if forced is not None:
         f_leaf, f_feat, f_bin = forced
         M = min(int(f_leaf.shape[0]), L - 1)
+        forced_ok = jnp.asarray(True)
         for i in range(M):
-            state = forced_step(state, f_leaf[i], f_feat[i], f_bin[i])
+            state, forced_ok = forced_step(state, forced_ok, f_leaf[i],
+                                           f_feat[i], f_bin[i])
 
     # growth loop: a while_loop with the stop condition in cond_fn (the
     # reference's early break, serial_tree_learner.cpp:225) — unlike a

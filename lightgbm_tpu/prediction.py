@@ -208,12 +208,13 @@ def predict_any(booster, data, start_iteration: int = 0,
         return np.asarray(leaves, np.int32)
 
     # the reference enables margin early-exit only when the objective
-    # tolerates inexact sums — classification, not regression
-    # (predictor.hpp:46 gates on !NeedAccuratePrediction())
+    # tolerates inexact sums (predictor.hpp:46 gates on
+    # !NeedAccuratePrediction(), overridden false ONLY by binary,
+    # multiclass and ranking objectives — cross-entropy keeps the
+    # default true and never early-stops)
     obj_name = (booster._objective_str or "none").split()[0]
     es_ok = obj_name in ("binary", "multiclass", "multiclassova",
-                         "softmax", "cross_entropy", "lambdarank",
-                         "rank_xendcg")
+                         "softmax", "lambdarank", "rank_xendcg")
     if pred_early_stop and es_ok and not booster._avg_output:
         scores = _predict_scores_early_stop(
             stacked, Xd, len(sel), K, max(1, pred_early_stop_freq),

@@ -1,0 +1,77 @@
+"""Regression tests for reference-semantics fixes.
+
+- cv() must row-subset ``position`` for position-debiased lambdarank
+  (Metadata subset semantics, dataset.h:48-398).
+- An invalid forced split aborts ALL remaining forced splits
+  (abort_last_forced_split, serial_tree_learner.cpp:695-699).
+- cross_entropy keeps NeedAccuratePrediction() == true, so prediction
+  early-stop must never engage for it (predictor.hpp:46).
+"""
+
+import json
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from conftest import make_synthetic_binary
+
+
+def _ranking_data(n_query=40, per_q=12, f=6, seed=3):
+    rs = np.random.RandomState(seed)
+    n = n_query * per_q
+    X = rs.randn(n, f)
+    y = rs.randint(0, 4, size=n).astype(np.float64)
+    group = np.full(n_query, per_q, np.int64)
+    position = np.tile(np.arange(per_q), n_query)
+    return X, y, group, position
+
+
+def test_cv_subsets_position():
+    X, y, group, position = _ranking_data()
+    ds = lgb.Dataset(X, label=y, group=group, position=position)
+    out = lgb.cv({"objective": "lambdarank", "num_leaves": 7,
+                  "verbosity": -1, "lambdarank_position_bias_regularization":
+                  0.5, "metric": "ndcg", "ndcg_eval_at": [3]},
+                 ds, num_boost_round=4, nfold=2, stratified=False)
+    key = [k for k in out if "ndcg" in k and "mean" in k][0]
+    assert len(out[key]) == 4
+    assert np.all(np.isfinite(out[key]))
+
+
+def test_invalid_forced_split_aborts_rest(tmp_path):
+    X, y = make_synthetic_binary(n=1500, f=5, seed=11)
+    # root forced at the median of feature 2 (valid); the left child is
+    # forced on the SAME (feature, threshold) — all its rows already
+    # satisfy f2 <= t, so the grandchild side is empty -> invalid. The
+    # abort must also discard the would-be-valid grandchild spec, so the
+    # model must equal a run forcing only the root split.
+    fs_full = {"feature": 2, "threshold": 0.0,
+               "left": {"feature": 2, "threshold": 0.0,
+                        "left": {"feature": 0, "threshold": 0.0}}}
+    fs_root = {"feature": 2, "threshold": 0.0}
+    p_full = tmp_path / "forced_full.json"
+    p_full.write_text(json.dumps(fs_full))
+    p_root = tmp_path / "forced_root.json"
+    p_root.write_text(json.dumps(fs_root))
+    base = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+            "min_data_in_leaf": 5}
+    b_full = lgb.train(dict(base, forcedsplits_filename=str(p_full)),
+                       lgb.Dataset(X, label=y), num_boost_round=2)
+    b_root = lgb.train(dict(base, forcedsplits_filename=str(p_root)),
+                       lgb.Dataset(X, label=y), num_boost_round=2)
+    for tf, tp in zip(b_full._models, b_root._models):
+        np.testing.assert_array_equal(tf.split_feature, tp.split_feature)
+        np.testing.assert_allclose(tf.threshold, tp.threshold)
+
+
+def test_cross_entropy_prediction_exact_with_early_stop():
+    X, y01 = make_synthetic_binary(n=1200, f=6, seed=17)
+    y = np.clip(y01 * 0.9 + 0.05, 0.0, 1.0)  # probabilistic labels
+    bst = lgb.train({"objective": "cross_entropy", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=30)
+    p_plain = bst.predict(X)
+    p_es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=1,
+                       pred_early_stop_margin=0.01)
+    # the aggressive margin would corrupt sums if early stop engaged
+    np.testing.assert_array_equal(p_plain, p_es)
