@@ -1,19 +1,22 @@
 """Training callbacks.
 
-Re-design of /root/reference/python-package/lightgbm/callback.py:
-``log_evaluation`` (:109), ``record_evaluation`` (:183),
-``reset_parameter`` (:254), ``early_stopping`` (:454 /
-``_EarlyStoppingCallback`` :278). The callback protocol (CallbackEnv,
-before/after ordering, EarlyStopException unwinding) matches the
-reference so user callbacks port unchanged.
+Own design covering the behavioral surface of the reference's callback
+module (/root/reference/python-package/lightgbm/callback.py:109,183,254,
+278,454): the ``CallbackEnv`` protocol, before/after-iteration ordering,
+and ``EarlyStopException`` unwinding are kept contract-compatible so user
+callbacks written for the reference port unchanged, but the machinery
+here is organized around a per-slot ``_MetricTracker`` instead of the
+reference's parallel best_* lists.
+
+Evaluation tuples are ``(dataset_name, metric_name, value,
+higher_is_better)`` — or with ``, stdv`` appended for cv aggregates.
 """
 
 from __future__ import annotations
 
 import collections
-from dataclasses import dataclass
-from functools import partial
-from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 from .utils.log import log_info, log_warning
 
@@ -22,6 +25,8 @@ __all__ = ["EarlyStopException", "CallbackEnv", "log_evaluation",
 
 
 class EarlyStopException(Exception):
+    """Raised by the early-stopping callback to unwind the train loop."""
+
     def __init__(self, best_iteration: int, best_score):
         super().__init__()
         self.best_iteration = best_iteration
@@ -34,222 +39,216 @@ CallbackEnv = collections.namedtuple(
      "evaluation_result_list"])
 
 
-def _fmt_eval(res: Tuple) -> str:
-    if len(res) == 4:
-        return f"{res[0]}'s {res[1]}: {res[2]:g}"
-    return f"{res[0]}'s {res[1]}: {res[2]:g} + {res[4]:g}"
+def _render(entry: Sequence, show_stdv: bool = True) -> str:
+    """One evaluation tuple -> 'data's metric: value[ + stdv]'."""
+    text = f"{entry[0]}'s {entry[1]}: {entry[2]:g}"
+    if show_stdv and len(entry) > 4:
+        text += f" + {entry[4]:g}"
+    return text
 
 
-class _LogEvaluationCallback:
-    order = 10
+def _render_all(entries: Sequence[Sequence], show_stdv: bool = True) -> str:
+    return "\t".join(_render(e, show_stdv) for e in entries)
 
-    def __init__(self, period: int = 1, show_stdv: bool = True):
-        self.period = period
-        self.show_stdv = show_stdv
-        self.before_iteration = False
+
+@dataclass(eq=False)
+class _LogEvaluation:
+    """Print the evaluation line every ``period`` iterations."""
+    period: int = 1
+    show_stdv: bool = True
+    order: int = 10
+    before_iteration: bool = False
 
     def __call__(self, env: CallbackEnv) -> None:
-        if self.period > 0 and env.evaluation_result_list \
-                and (env.iteration + 1) % self.period == 0:
-            result = "\t".join(
-                _fmt_eval(x) for x in env.evaluation_result_list)
-            log_info(f"[{env.iteration + 1}]\t{result}")
+        if self.period <= 0 or not env.evaluation_result_list:
+            return
+        if (env.iteration + 1) % self.period == 0:
+            log_info(f"[{env.iteration + 1}]\t"
+                     f"{_render_all(env.evaluation_result_list, self.show_stdv)}")
 
 
 def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
-    return _LogEvaluationCallback(period=period, show_stdv=show_stdv)
+    return _LogEvaluation(period=period, show_stdv=show_stdv)
 
 
-class _RecordEvaluationCallback:
-    order = 20
+@dataclass(eq=False)
+class _RecordEvaluation:
+    """Append every metric value into a user-provided nested dict."""
+    eval_result: Dict
+    order: int = 20
+    before_iteration: bool = False
 
-    def __init__(self, eval_result: Dict):
-        if not isinstance(eval_result, dict):
+    def __post_init__(self):
+        if not isinstance(self.eval_result, dict):
             raise TypeError("eval_result should be a dictionary")
-        self.eval_result = eval_result
-        self.before_iteration = False
-
-    def _init(self, env: CallbackEnv) -> None:
-        self.eval_result.clear()
-        for item in env.evaluation_result_list:
-            data_name, eval_name = item[0], item[1]
-            self.eval_result.setdefault(data_name, collections.OrderedDict())
-            if len(item) == 4:
-                self.eval_result[data_name].setdefault(eval_name, [])
-            else:
-                self.eval_result[data_name].setdefault(eval_name, [])
-                self.eval_result[data_name].setdefault(
-                    f"{eval_name}-stdv", [])
 
     def __call__(self, env: CallbackEnv) -> None:
         if env.iteration == env.begin_iteration:
-            self._init(env)
-        for item in env.evaluation_result_list:
-            if len(item) == 4:
-                data_name, eval_name, result = item[:3]
-                self.eval_result[data_name][eval_name].append(result)
-            else:
-                data_name, eval_name, result, _, stdv = item
-                self.eval_result[data_name][eval_name].append(result)
-                self.eval_result[data_name][f"{eval_name}-stdv"].append(stdv)
+            self.eval_result.clear()
+        for entry in env.evaluation_result_list:
+            data_slot = self.eval_result.setdefault(
+                entry[0], collections.OrderedDict())
+            data_slot.setdefault(entry[1], []).append(entry[2])
+            if len(entry) > 4:
+                data_slot.setdefault(f"{entry[1]}-stdv", []).append(entry[4])
 
 
 def record_evaluation(eval_result: Dict) -> Callable:
-    return _RecordEvaluationCallback(eval_result)
+    return _RecordEvaluation(eval_result)
 
 
-class _ResetParameterCallback:
-    order = 10
-
-    def __init__(self, **kwargs):
-        self.kwargs = kwargs
-        self.before_iteration = True
+@dataclass(eq=False)
+class _ResetParameter:
+    """Per-iteration parameter schedule: list lookup or callable."""
+    schedule: Dict[str, Any]
+    order: int = 10
+    before_iteration: bool = True
 
     def __call__(self, env: CallbackEnv) -> None:
-        new_parameters = {}
-        for key, value in self.kwargs.items():
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
+        step = env.iteration - env.begin_iteration
+        changed: Dict[str, Any] = {}
+        for name, spec in self.schedule.items():
+            if isinstance(spec, list):
+                if len(spec) != env.end_iteration - env.begin_iteration:
                     raise ValueError(
-                        f"Length of list {key!r} has to equal to "
+                        f"Length of list {name!r} has to equal to "
                         "'num_boost_round'.")
-                new_param = value[env.iteration - env.begin_iteration]
-            elif callable(value):
-                new_param = value(env.iteration - env.begin_iteration)
+                value = spec[step]
+            elif callable(spec):
+                value = spec(step)
             else:
-                raise ValueError("Only list and callable values are "
-                                 "supported as a mapping from boosting "
-                                 "round index to new parameter value.")
-            if new_param != env.params.get(key, None):
-                new_parameters[key] = new_param
-        if new_parameters:
-            if "learning_rate" in new_parameters and env.model is not None:
-                env.model._engine._shrinkage = \
-                    new_parameters["learning_rate"]
-            env.params.update(new_parameters)
+                raise ValueError(
+                    "Only list and callable values are supported as a "
+                    "mapping from boosting round index to new parameter "
+                    "value.")
+            if value != env.params.get(name, None):
+                changed[name] = value
+        if changed:
+            if "learning_rate" in changed and env.model is not None:
+                env.model._engine._shrinkage = changed["learning_rate"]
+            env.params.update(changed)
 
 
 def reset_parameter(**kwargs) -> Callable:
-    return _ResetParameterCallback(**kwargs)
+    return _ResetParameter(kwargs)
 
 
-class _EarlyStoppingCallback:
-    """Early stopping on validation metrics (callback.py:278)."""
+@dataclass(eq=False)
+class _MetricTracker:
+    """Best-so-far state for one (dataset, metric) evaluation slot."""
+    higher_is_better: bool
+    min_delta: float
+    best_value: float = 0.0
+    best_iteration: int = 0
+    best_entries: Optional[List] = None
 
-    order = 30
+    def __post_init__(self):
+        self.best_value = float("-inf") if self.higher_is_better \
+            else float("inf")
 
-    def __init__(self, stopping_rounds: int, first_metric_only: bool = False,
-                 verbose: bool = True,
-                 min_delta: Union[float, List[float]] = 0.0):
-        if stopping_rounds <= 0:
+    def improved(self, value: float) -> bool:
+        if self.higher_is_better:
+            return value > self.best_value + self.min_delta
+        return value < self.best_value - self.min_delta
+
+
+@dataclass(eq=False)
+class _EarlyStopping:
+    """Stop when no tracked slot improves for ``stopping_rounds`` rounds.
+
+    Train-set slots (the Booster's own train data, and cv train-fold
+    aggregates) update their trackers but never trigger a stop — only
+    held-out data counts, matching the reference's gating.
+    """
+    stopping_rounds: int
+    first_metric_only: bool = False
+    verbose: bool = True
+    min_delta: Union[float, List[float]] = 0.0
+    order: int = 30
+    before_iteration: bool = False
+    enabled: bool = True
+    trackers: List[_MetricTracker] = field(default_factory=list)
+    _primary_metric: str = ""
+
+    def __post_init__(self):
+        if self.stopping_rounds <= 0:
             raise ValueError("stopping_rounds should be greater than zero.")
-        self.stopping_rounds = stopping_rounds
-        self.first_metric_only = first_metric_only
-        self.verbose = verbose
-        self.min_delta = min_delta
-        self.before_iteration = False
-        self.enabled = True
-        self._reset_storages()
 
-    def _reset_storages(self) -> None:
-        self.best_score: List[float] = []
-        self.best_iter: List[int] = []
-        self.best_score_list: List[Any] = []
-        self.cmp_op: List[Callable[[float, float], bool]] = []
-        self.first_metric = ""
-
-    def _init(self, env: CallbackEnv) -> None:
-        self._reset_storages()
-        if not env.evaluation_result_list:
-            raise ValueError(
-                "For early stopping, at least one dataset and eval metric "
-                "is required for evaluation")
-        n_metrics = len({m[1] for m in env.evaluation_result_list})
-        n_datasets = len(env.evaluation_result_list) // max(n_metrics, 1)
+    def _deltas_per_slot(self, entries: Sequence) -> List[float]:
+        metric_count = len({e[1] for e in entries})
+        dataset_count = len(entries) // max(metric_count, 1)
         if isinstance(self.min_delta, list):
-            if len(self.min_delta) != n_metrics:
+            if len(self.min_delta) != metric_count:
                 raise ValueError(
                     "Must provide a single value for min_delta or as many "
                     "as metrics.")
             if self.first_metric_only and self.verbose:
                 log_info(f"Using only {self.min_delta[0]} as early "
                          "stopping min_delta.")
-            deltas = self.min_delta * n_datasets
-        else:
-            if self.min_delta < 0:
-                raise ValueError("Early stopping min_delta must be "
-                                 "non-negative.")
-            deltas = [self.min_delta] * n_datasets * n_metrics
-        self.first_metric = env.evaluation_result_list[0][1]
-        for eval_ret, delta in zip(env.evaluation_result_list, deltas):
-            self.best_iter.append(0)
-            if eval_ret[3]:  # higher is better
-                self.best_score.append(float("-inf"))
-                self.cmp_op.append(partial(self._gt_delta, delta=delta))
-            else:
-                self.best_score.append(float("inf"))
-                self.cmp_op.append(partial(self._lt_delta, delta=delta))
-            self.best_score_list.append(None)
+            return self.min_delta * dataset_count
+        if self.min_delta < 0:
+            raise ValueError("Early stopping min_delta must be "
+                             "non-negative.")
+        return [self.min_delta] * (dataset_count * metric_count)
 
-    @staticmethod
-    def _gt_delta(curr: float, best: float, delta: float) -> bool:
-        return curr > best + delta
+    def _start(self, env: CallbackEnv) -> None:
+        if not env.evaluation_result_list:
+            raise ValueError(
+                "For early stopping, at least one dataset and eval metric "
+                "is required for evaluation")
+        deltas = self._deltas_per_slot(env.evaluation_result_list)
+        self.trackers = [
+            _MetricTracker(higher_is_better=bool(entry[3]), min_delta=d)
+            for entry, d in zip(env.evaluation_result_list, deltas)]
+        self._primary_metric = \
+            env.evaluation_result_list[0][1].split(" ")[-1]
 
-    @staticmethod
-    def _lt_delta(curr: float, best: float, delta: float) -> bool:
-        return curr < best - delta
+    def _is_train_slot(self, env: CallbackEnv, entry: Sequence) -> bool:
+        metric_tail = entry[1].split(" ")
+        if entry[0] == "cv_agg" and metric_tail[0] == "train":
+            return True
+        if env.model is not None and entry[0] == env.model._train_data_name:
+            return True
+        return False
 
-    def _final_iteration_check(self, env, eval_name_splitted, i) -> None:
-        if env.iteration == env.end_iteration - 1:
-            if self.verbose:
-                best = "\t".join(
-                    _fmt_eval(x) for x in self.best_score_list[i])
-                log_info("Did not meet early stopping. Best iteration is:"
-                         f"\n[{self.best_iter[i] + 1}]\t{best}")
-                if self.first_metric_only:
-                    log_info(f"Evaluated only: {eval_name_splitted[-1]}")
-            raise EarlyStopException(self.best_iter[i],
-                                     self.best_score_list[i])
+    def _stop(self, tracker: _MetricTracker, reason: str) -> None:
+        if self.verbose:
+            log_info(f"{reason}, best iteration is:\n"
+                     f"[{tracker.best_iteration + 1}]\t"
+                     f"{_render_all(tracker.best_entries)}")
+            if self.first_metric_only:
+                log_info(f"Evaluated only: {self._primary_metric}")
+        raise EarlyStopException(tracker.best_iteration,
+                                 tracker.best_entries)
 
     def __call__(self, env: CallbackEnv) -> None:
         if env.iteration == env.begin_iteration:
-            self._init(env)
+            self._start(env)
         if not self.enabled:
             return
-        for i in range(len(env.evaluation_result_list)):
-            score = env.evaluation_result_list[i][2]
-            if self.best_score_list[i] is None \
-                    or self.cmp_op[i](score, self.best_score[i]):
-                self.best_score[i] = score
-                self.best_iter[i] = env.iteration
-                self.best_score_list[i] = env.evaluation_result_list
-            eval_name_splitted = env.evaluation_result_list[i][1].split(" ")
+        last_round = env.iteration == env.end_iteration - 1
+        for tracker, entry in zip(self.trackers,
+                                  env.evaluation_result_list):
+            if tracker.best_entries is None \
+                    or tracker.improved(entry[2]):
+                tracker.best_value = entry[2]
+                tracker.best_iteration = env.iteration
+                tracker.best_entries = list(env.evaluation_result_list)
             if self.first_metric_only \
-                    and self.first_metric != eval_name_splitted[-1]:
+                    and entry[1].split(" ")[-1] != self._primary_metric:
                 continue
-            if env.evaluation_result_list[i][0] == "cv_agg" \
-                    and eval_name_splitted[0] == "train":
+            if self._is_train_slot(env, entry):
                 continue
-            if env.model is not None and env.evaluation_result_list[i][0] \
-                    == env.model._train_data_name:
-                continue
-            if env.iteration - self.best_iter[i] >= self.stopping_rounds:
-                if self.verbose:
-                    best = "\t".join(
-                        _fmt_eval(x) for x in self.best_score_list[i])
-                    log_info("Early stopping, best iteration is:"
-                             f"\n[{self.best_iter[i] + 1}]\t{best}")
-                    if self.first_metric_only:
-                        log_info(
-                            f"Evaluated only: {eval_name_splitted[-1]}")
-                raise EarlyStopException(self.best_iter[i],
-                                         self.best_score_list[i])
-            self._final_iteration_check(env, eval_name_splitted, i)
+            if env.iteration - tracker.best_iteration \
+                    >= self.stopping_rounds:
+                self._stop(tracker, "Early stopping")
+            if last_round:
+                self._stop(tracker, "Did not meet early stopping")
 
 
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True,
                    min_delta: Union[float, List[float]] = 0.0) -> Callable:
-    return _EarlyStoppingCallback(stopping_rounds=stopping_rounds,
-                                  first_metric_only=first_metric_only,
-                                  verbose=verbose, min_delta=min_delta)
+    return _EarlyStopping(stopping_rounds=stopping_rounds,
+                          first_metric_only=first_metric_only,
+                          verbose=verbose, min_delta=min_delta)

@@ -129,6 +129,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.best_iteration = booster.current_iteration()
     for item in (evaluation_result_list or []):
         booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+    from .utils.timer import Timer
+    if Timer.enabled():
+        Timer.log_summary()
     return booster
 
 

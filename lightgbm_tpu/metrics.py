@@ -44,6 +44,7 @@ METRIC_ALIASES = {
     "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
     "softmax": "multi_logloss", "multiclassova": "multi_logloss",
     "multi_error": "multi_error",
+    "auc_mu": "auc_mu",
     "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
     "cross_entropy_lambda": "cross_entropy_lambda",
     "xentlambda": "cross_entropy_lambda",
@@ -268,6 +269,63 @@ class MultiLogloss(Metric):
         return _mean(-jnp.log(jnp.clip(py, eps, 1.0)), weight)
 
 
+class AucMu(Metric):
+    """AUC-mu multiclass ranking metric (Kleiman & Page), matching the
+    reference's AucMuMetric (src/metric/multiclass_metric.hpp:183):
+    pairwise class separability along the partition-vector direction
+    ``v = W[i] - W[j]``, averaged over all class pairs. ``auc_mu_weights``
+    supplies the flattened [K, K] misclassification-cost matrix W
+    (default: ones off the diagonal, src/io/config.cpp:220-241)."""
+
+    name = "auc_mu"
+    higher_better = True
+
+    def eval(self, raw_score, label, weight, convert_fn):
+        import numpy as np
+        score = np.asarray(raw_score, np.float64)        # [K, n]
+        y = np.asarray(label).astype(np.int64)
+        K = score.shape[0]
+        if K < 2:
+            # the reference's double arithmetic yields nan for a single
+            # class; keep training alive the same way
+            return jnp.asarray(np.nan)
+        W = self.cfg.auc_mu_weights
+        if W:
+            if len(W) != K * K:
+                raise ValueError(
+                    f"auc_mu_weights must have {K * K} elements")
+            W = np.asarray(W, np.float64).reshape(K, K)
+            np.fill_diagonal(W, 0.0)
+        else:
+            W = 1.0 - np.eye(K)
+        w = None if weight is None else np.asarray(weight, np.float64)
+        cls_w = np.array([
+            (np.sum(y == c) if w is None else np.sum(w[y == c]))
+            for c in range(K)], np.float64)
+
+        total = 0.0
+        for i in range(K):
+            for j in range(i + 1, K):
+                sel = (y == i) | (y == j)
+                v = W[i] - W[j]
+                d = (v[i] - v[j]) * (v @ score[:, sel])
+                is_j = (y[sel] == j).astype(np.float64)
+                ww = np.ones_like(d) if w is None else w[sel]
+                # Mann-Whitney with eps-ties worth half a concordance
+                # (the reference's last_j_dist streaming tie rule)
+                order = np.lexsort((-is_j, d))
+                d_s, j_s, w_s = d[order], is_j[order], ww[order]
+                j_mass = np.cumsum(j_s * w_s)
+                lo = np.searchsorted(d_s, d_s - 1e-15, side="left")
+                hi = np.searchsorted(d_s, d_s + 1e-15, side="right")
+                before = np.where(lo > 0, j_mass[np.maximum(lo - 1, 0)], 0.0)
+                tied = j_mass[hi - 1] - before
+                i_mask = j_s == 0
+                s_ij = np.sum((w_s * (before + 0.5 * tied))[i_mask])
+                total += s_ij / (cls_w[i] * cls_w[j])
+        return jnp.asarray(2.0 * total / (K * (K - 1)))
+
+
 class MultiError(Metric):
     name = "multi_error"
 
@@ -289,6 +347,7 @@ _REGISTRY = {
     "binary_logloss": _binary_logloss, "binary_error": _binary_error,
     "auc": AUC, "average_precision": AveragePrecision,
     "multi_logloss": MultiLogloss, "multi_error": MultiError,
+    "auc_mu": AucMu,
     "cross_entropy": _xentropy, "cross_entropy_lambda": _xentlambda,
     "kldiv": _kldiv,
 }

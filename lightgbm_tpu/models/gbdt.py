@@ -165,6 +165,17 @@ class GBDTBooster:
         if self.interaction_groups is not None or self.forced is not None \
                 or self.cegb_enabled:
             grower = "compact"  # per-leaf masks / forced splits need it
+        if cfg.path_smooth > 0.0 or cfg.feature_fraction_bynode < 1.0 \
+                or self.monotone is not None:
+            # path smoothing, per-node column sampling and monotone
+            # output-bound entries live on the compact grower
+            grower = "compact"
+        if self.monotone is not None \
+                and cfg.monotone_constraints_method == "advanced":
+            raise ValueError(
+                "monotone_constraints_method=advanced is not implemented; "
+                "use basic or intermediate "
+                "(AdvancedLeafConstraints, monotone_constraints.hpp:858)")
         self.grow_cfg_extra = {}
         self.grow_cfg = GrowConfig(
             num_leaves=cfg.num_leaves,
@@ -182,6 +193,8 @@ class GBDTBooster:
             cegb_coupled=len(cfg.cegb_penalty_feature_coupled) > 0,
             cegb_tradeoff=cfg.cegb_tradeoff,
             cegb_split=cfg.cegb_penalty_split,
+            monotone_method=cfg.monotone_constraints_method,
+            bynode=cfg.feature_fraction_bynode,
             split=SplitParams(
                 lambda_l1=cfg.lambda_l1,
                 lambda_l2=cfg.lambda_l2,
@@ -194,6 +207,9 @@ class GBDTBooster:
                 max_cat_threshold=cfg.max_cat_threshold,
                 max_cat_to_onehot=cfg.max_cat_to_onehot,
                 min_data_per_group=float(cfg.min_data_per_group),
+                path_smooth=cfg.path_smooth,
+                monotone_penalty=(cfg.monotone_penalty
+                                  if self.monotone is not None else 0.0),
             ),
         )
         # -- distributed setup: mesh instead of Network::Init ------------
@@ -222,10 +238,14 @@ class GBDTBooster:
                 self.feat_is_cat is not None,
                 cfg.use_quantized_grad and cfg.stochastic_rounding,
                 self.interaction_groups is not None,
-                self.forced is not None)
+                self.forced is not None,
+                cfg.feature_fraction_bynode < 1.0)
 
         seed = cfg.seed if cfg.seed is not None else 0
         self._base_key = jax.random.PRNGKey(seed)
+        # distinct stream for per-node column sampling (ColSampler's
+        # feature_fraction_seed, col_sampler.hpp)
+        self._bynode_key = jax.random.PRNGKey(cfg.feature_fraction_seed)
         self._feature_rng = np.random.RandomState(cfg.feature_fraction_seed)
         # DART state (dart.hpp)
         self._dart_rng = np.random.RandomState(cfg.drop_seed)
@@ -670,29 +690,39 @@ class GBDTBooster:
             if drop_idx:
                 self._dart_apply_drop(drop_idx)
 
-        if custom_grad is not None:
-            grad = jnp.asarray(custom_grad, jnp.float32).reshape(self.K,
-                                                                 self.n)
-            hess = jnp.asarray(custom_hess, jnp.float32).reshape(self.K,
-                                                                 self.n)
-        elif cfg.boosting == "rf":
-            # RF trees are independent: gradients always from the init
-            # score, never the running average (rf.hpp Boosting)
-            init = jnp.tile(jnp.asarray(self.init_score,
-                                        jnp.float32)[:, None], (1, self.n))
-            grad, hess = self._gradients(init)
-        else:
-            grad, hess = self._gradients(self.score)
+        # phase annotations: the USE_TIMETAG points of GBDT::TrainOneIter
+        # (gbdt.cpp:221-492) — see utils/timer.py
+        from ..utils.timer import timed
 
-        row_w = self._row_weights(it, grad[0] if self.K == 1 else grad,
-                                  hess[0] if self.K == 1 else hess)
-        fmask = self._feature_mask()
+        with timed("boosting/gradients"):
+            if custom_grad is not None:
+                grad = jnp.asarray(custom_grad,
+                                   jnp.float32).reshape(self.K, self.n)
+                hess = jnp.asarray(custom_hess,
+                                   jnp.float32).reshape(self.K, self.n)
+            elif cfg.boosting == "rf":
+                # RF trees are independent: gradients always from the init
+                # score, never the running average (rf.hpp Boosting)
+                init = jnp.tile(jnp.asarray(self.init_score,
+                                            jnp.float32)[:, None],
+                                (1, self.n))
+                grad, hess = self._gradients(init)
+            else:
+                grad, hess = self._gradients(self.score)
+
+        with timed("boosting/bagging"):
+            row_w = self._row_weights(it, grad[0] if self.K == 1 else grad,
+                                      hess[0] if self.K == 1 else hess)
+            fmask = self._feature_mask()
 
         shrinkage = self._shrinkage if cfg.boosting != "rf" else 1.0
         grew_any = False
         quant_key = None
         if cfg.use_quantized_grad and cfg.stochastic_rounding:
             quant_key = jax.random.fold_in(self._base_key, it)
+        node_key = None
+        if cfg.feature_fraction_bynode < 1.0:
+            node_key = jax.random.fold_in(self._bynode_key, it)
         for k in range(self.K):
             if self.mesh is not None:
                 gk = grad[k]
@@ -714,7 +744,10 @@ class GBDTBooster:
                     args = args + (self.interaction_groups,)
                 if self.forced is not None:
                     args = args + self.forced
-                dev_tree, row_leaf = self._grow_fn(*args)
+                if node_key is not None:
+                    args = args + (jax.random.fold_in(node_key, k),)
+                with timed("tree_learner/grow"):
+                    dev_tree, row_leaf = self._grow_fn(*args)
                 row_leaf = row_leaf[: self.n]
             else:
                 cegb_arrays = None
@@ -723,13 +756,17 @@ class GBDTBooster:
                                    self._cegb_pen_lazy,
                                    self._cegb_coupled,
                                    self._cegb_lazy_used)
-                out = grow_tree(
-                    self.grow_cfg, self.bins_T, grad[k], hess[k], row_w,
-                    fmask, self.feat_num_bins, self.feat_nan_bin,
-                    self.monotone, self.feat_is_cat,
-                    None if quant_key is None
-                    else jax.random.fold_in(quant_key, k),
-                    self.interaction_groups, self.forced, cegb_arrays)
+                with timed("tree_learner/grow"):
+                    out = grow_tree(
+                        self.grow_cfg, self.bins_T, grad[k], hess[k],
+                        row_w, fmask, self.feat_num_bins,
+                        self.feat_nan_bin,
+                        self.monotone, self.feat_is_cat,
+                        None if quant_key is None
+                        else jax.random.fold_in(quant_key, k),
+                        self.interaction_groups, self.forced, cegb_arrays,
+                        None if node_key is None
+                        else jax.random.fold_in(node_key, k))
                 if self.cegb_enabled:
                     dev_tree, row_leaf, self._cegb_coupled, lz = out
                     if self.cegb_lazy:
@@ -861,8 +898,9 @@ class GBDTBooster:
             else:
                 # train-score update via the leaf partition — no
                 # re-traversal (ScoreUpdater::AddScore, score_updater.hpp)
-                self.score = self.score.at[k].add(
-                    contrib_raw * shrinkage)
+                with timed("boosting/update_score"):
+                    self.score = self.score.at[k].add(
+                        contrib_raw * shrinkage)
                 if it == 0 and self._fold_bias \
                         and self.init_score[k] != 0.0:
                     # internal score already starts at init; nothing to add

@@ -32,8 +32,8 @@ from jax import lax
 
 from .histogram import (build_histogram, hist_from_rows,
                         hist_from_rows_int, subtract_histogram)
-from .split import (SplitParams, SplitResult, find_best_split, leaf_gain,
-                    leaf_output)
+from .split import (SplitParams, SplitResult, constrained_output,
+                    find_best_split, gain_at_output, leaf_gain, leaf_output)
 
 __all__ = ["GrowConfig", "TreeArrays", "grow_tree"]
 
@@ -76,6 +76,18 @@ class GrowConfig(NamedTuple):
     cegb_coupled: bool = False   # any cegb_penalty_feature_coupled > 0
     cegb_tradeoff: float = 1.0
     cegb_split: float = 0.0
+    # monotone constraint strategy (LeafConstraintsBase::Create,
+    # monotone_constraints.hpp:1176): "basic" tracks per-leaf output
+    # bounds set to the split midpoint; "intermediate" uses the sibling
+    # subtree's extreme CURRENT outputs, refreshed (and every leaf's
+    # best split re-searched) after each split — the batch fixed-point
+    # of the reference's leaves_to_update propagation
+    # (IntermediateLeafConstraints::Update), without the per-threshold
+    # range refinement.
+    monotone_method: str = "basic"
+    # feature_fraction_bynode (ColSampler::GetByNode, col_sampler.hpp):
+    # a fresh feature subset sampled per node from the per-tree set
+    bynode: float = 1.0
 
 
 class TreeArrays(NamedTuple):
@@ -251,7 +263,8 @@ def grow_tree_impl(cfg: GrowConfig,
                    quant_key: Optional[jnp.ndarray] = None,
                    interaction_groups: Optional[jnp.ndarray] = None,
                    forced: Optional[tuple] = None,
-                   cegb_arrays: Optional[tuple] = None):
+                   cegb_arrays: Optional[tuple] = None,
+                   node_key: Optional[jnp.ndarray] = None):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf)
     (+ (coupled_used, lazy_used) when cfg.cegb).
 
@@ -268,18 +281,28 @@ def grow_tree_impl(cfg: GrowConfig,
       forced: optional (leaf [M], feature [M], bin [M]) i32 arrays — the
         pre-planned forced splits (forcedsplits_filename, BFS order);
         compact grower only.
+      node_key: PRNG key for per-node column sampling
+        (feature_fraction_bynode; cfg.bynode < 1).
     """
     if cfg.grower == "compact":
         return _grow_compact_impl(cfg, bins_T, grad, hess, row_weight,
                                   feature_mask, feat_num_bins, feat_nan_bin,
                                   monotone_constraints, feat_is_cat,
                                   quant_key, interaction_groups, forced,
-                                  cegb_arrays)
+                                  cegb_arrays, node_key)
     if interaction_groups is not None or forced is not None \
             or cegb_arrays is not None:
         raise NotImplementedError(
             "interaction_constraints/forced splits/CEGB require the "
             "compact grower")
+    if cfg.bynode < 1.0 or cfg.split.path_smooth > 0.0:
+        # path smoothing and per-node column sampling live on the
+        # flagship compact grower only (gbdt.py routes those configs
+        # there); the masked grower keeps monotone as a validity check
+        # without output-bound entries (legacy behavior).
+        raise NotImplementedError(
+            "path_smooth/feature_fraction_bynode require the compact "
+            "grower")
     return _grow_masked_impl(cfg, bins_T, grad, hess, row_weight,
                              feature_mask, feat_num_bins, feat_nan_bin,
                              monotone_constraints, feat_is_cat)
@@ -423,6 +446,13 @@ class _CompactState(NamedTuple):
     num_splits: jnp.ndarray  # scalar i32
     cegb: tuple = ()         # (coupled_used [F], lazy_used [n,F],
                              #  lazy_nu [L,F]) when cfg.cegb
+    mono: tuple = ()         # (leaf_min [L], leaf_max [L]) output-bound
+                             # entries (BasicConstraint analogs) when
+                             # monotone constraints are active; plus
+                             # (anc [L, L-1] i8: 0=not under node,
+                             # 1=left subtree, 2=right) for intermediate
+    node_masks: tuple = ()   # ([L, F] bool,) — per-node sampled feature
+                             # sets when cfg.bynode < 1
 
 
 def _row_leaf_from_order(order, leaf_begin, leaf_count, n, L):
@@ -457,7 +487,8 @@ def _grow_compact_impl(cfg: GrowConfig,
                        quant_key: Optional[jnp.ndarray] = None,
                        interaction_groups: Optional[jnp.ndarray] = None,
                        forced: Optional[tuple] = None,
-                       cegb_arrays: Optional[tuple] = None):
+                       cegb_arrays: Optional[tuple] = None,
+                       node_key: Optional[jnp.ndarray] = None):
     """Leaf-wise growth with rows kept PHYSICALLY grouped by leaf.
 
     The reference's DataPartition (data_partition.hpp) + CUDA partition
@@ -486,12 +517,33 @@ def _grow_compact_impl(cfg: GrowConfig,
     def psum(x):
         return lax.psum(x, cfg.axis_name) if cfg.axis_name else x
 
-    def best_for(hist, sg, sh, sc, extra_mask=None, gain_penalty=None):
+    has_mono = monotone_constraints is not None
+    intermediate = has_mono and cfg.monotone_method == "intermediate"
+    use_bynode = cfg.bynode < 1.0 and node_key is not None
+    smoothing = p.path_smooth > 0.0
+
+    def best_for(hist, sg, sh, sc, extra_mask=None, gain_penalty=None,
+                 parent_output=None, depth=None, bounds=None):
         fmask = feature_mask if extra_mask is None \
             else feature_mask & extra_mask
         return find_best_split(hist, sg, sh, sc, feat_num_bins, feat_nan_bin,
                                fmask, p, monotone_constraints,
-                               feat_is_cat, gain_penalty)
+                               feat_is_cat, gain_penalty, parent_output,
+                               depth, bounds)
+
+    def node_feature_mask(idx):
+        """Per-node feature subset (ColSampler::GetByNode): rank a fresh
+        uniform draw over the tree's usable features, keep
+        max(1, round(bynode * |usable|)). The reference samples with its
+        sequential Random stream; this keyed-fold stream is an equally
+        deterministic redesign."""
+        u = jax.random.uniform(jax.random.fold_in(node_key, idx), (F,))
+        u = jnp.where(feature_mask, u, jnp.inf)
+        rank = jnp.argsort(jnp.argsort(u))
+        total = jnp.sum(feature_mask.astype(jnp.int32))
+        k = jnp.maximum(jnp.round(total * cfg.bynode).astype(jnp.int32),
+                        jnp.minimum(1, total))
+        return (rank < k) & feature_mask
 
     def allowed_features(branch_set):
         """Features usable at a node whose path used ``branch_set``
@@ -788,8 +840,30 @@ def _grow_compact_impl(cfg: GrowConfig,
         lazy_nu = jnp.zeros((L, F), dtype).at[0].set(root_nu)
         cegb_state = (coupled_used, lazy_used, lazy_nu)
         root_pen = cegb_penalty(total_c, coupled_used, root_nu)
+    mono_state = ()
+    root_bounds = None
+    if has_mono:
+        leaf_min0 = jnp.full((L,), -jnp.inf, dtype)
+        leaf_max0 = jnp.full((L,), jnp.inf, dtype)
+        mono_state = (leaf_min0, leaf_max0)
+        if intermediate:
+            mono_state = mono_state + (jnp.zeros((L, L - 1), jnp.int8),)
+        root_bounds = (leaf_min0[0], leaf_max0[0])
+    nmask_state = ()
+    root_node_mask = None
+    if use_bynode:
+        root_node_mask = node_feature_mask(0)
+        nmask_state = (jnp.zeros((L, F), jnp.bool_)
+                       .at[0].set(root_node_mask),)
+        root_mask = root_node_mask if root_mask is None \
+            else root_mask & root_node_mask
+    # the root's "parent output" is its own unsmoothed output
+    # (GetParentOutput, serial_tree_learner.cpp:1005-1012)
+    root_out = tree.leaf_value[0]
     best = best.store(0, best_for(hist_f(root_hist), total_g, total_h,
-                                  total_c, root_mask, root_pen),
+                                  total_c, root_mask, root_pen,
+                                  root_out, jnp.asarray(0, jnp.int32),
+                                  root_bounds),
                       jnp.asarray(True))
     hists = jnp.zeros((L, F, B, 2),
                       jnp.int32 if quant else dtype).at[0].set(root_hist)
@@ -809,17 +883,63 @@ def _grow_compact_impl(cfg: GrowConfig,
         leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(n),
         branch=jnp.zeros((L, F), jnp.bool_),
         num_splits=jnp.asarray(0, jnp.int32),
-        cegb=cegb_state)
+        cegb=cegb_state, mono=mono_state, node_masks=nmask_state)
 
     def depth_ok(d):
         if cfg.max_depth <= 0:
             return jnp.asarray(True)
         return d < cfg.max_depth
 
+    def research_all(tree, hists, branch, cegb_st, mono_st, nmask_st
+                     ) -> _BestSplits:
+        """Re-search every leaf's best split from the cached histograms
+        under the CURRENT penalties / interaction masks / monotone
+        bounds. Exact replacement for the reference's stored-candidate
+        patching (CEGB UpdateLeafBestSplits,
+        cost_effective_gradient_boosting.hpp:100-124; intermediate
+        monotone leaves_to_update, monotone_constraints.hpp:560+)."""
+        hf = jax.vmap(hist_f)(hists)              # [L, F, B, 2]
+        sums = hf[:, 0].sum(axis=1)               # [L, 2]
+        in_axes = [0, 0, 0, 0]
+        args = [hf, sums[:, 0], sums[:, 1], tree.leaf_count]
+        masks = None if interaction_groups is None \
+            else jax.vmap(allowed_features)(branch)
+        if use_bynode:
+            masks = nmask_st[0] if masks is None else masks & nmask_st[0]
+        in_axes.append(None if masks is None else 0)
+        args.append(masks)
+        if cegb:
+            coupled_used, _, lazy_nu = cegb_st
+            pens = jax.vmap(cegb_penalty,
+                            in_axes=(0, None, 0))(tree.leaf_count,
+                                                  coupled_used, lazy_nu)
+        else:
+            pens = None
+        in_axes.append(None if pens is None else 0)
+        args.append(pens)
+        # per-leaf parent_output / depth / bounds
+        in_axes.extend([0, 0])
+        args.extend([tree.leaf_value, tree.leaf_depth])
+        if has_mono:
+            in_axes.append((0, 0))
+            args.append((mono_st[0], mono_st[1]))
+        else:
+            in_axes.append(None)
+            args.append(None)
+        r = jax.vmap(best_for, in_axes=tuple(in_axes))(*args)
+        if cfg.max_depth > 0:
+            allowed = tree.leaf_depth < cfg.max_depth
+        else:
+            allowed = jnp.ones((L,), jnp.bool_)
+        # SplitResult and _BestSplits share field order; re-wrap so the
+        # while-loop carry keeps a consistent pytree type
+        return _BestSplits(jnp.where(allowed, r.gain, NEG_INF),
+                           *tuple(r)[1:])
+
     def do_split(state: _CompactState,
                  leaf_override=None) -> _CompactState:
         (tree, best, hists, bins_ord, pay_ord, ib_ord, order, _scr,
-         lbegin, lcount, branch, ns, cegb_st) = state
+         lbegin, lcount, branch, ns, cegb_st, mono_st, nmask_st) = state
         leaf = jnp.argmax(best.gain).astype(jnp.int32) \
             if leaf_override is None else leaf_override
         R = ns + 1
@@ -851,6 +971,37 @@ def _grow_compact_impl(cfg: GrowConfig,
         right_hist = jnp.where(left_smaller, big_hist, small_hist)
         hists = hists.at[leaf].set(left_hist).at[R].set(right_hist)
 
+        # -- monotone output-bound entries (BasicLeafConstraints::Update /
+        # IntermediateLeafConstraints::UpdateConstraintsWithOutputs) --
+        wl_out = best.left_output[leaf]
+        wr_out = best.right_output[leaf]
+        bounds_l = bounds_r = None
+        if has_mono:
+            lmin, lmax = mono_st[0], mono_st[1]
+            pmin, pmax = lmin[leaf], lmax[leaf]
+            mc_f = monotone_constraints[f_split].astype(jnp.int32)
+            is_num = ~isc
+            inc = is_num & (mc_f > 0)
+            dec = is_num & (mc_f < 0)
+            if intermediate:
+                val_left, val_right = wr_out, wl_out
+            else:
+                val_left = val_right = (wl_out + wr_out) * 0.5
+            new_min_l = jnp.where(dec, jnp.maximum(pmin, val_left), pmin)
+            new_max_l = jnp.where(inc, jnp.minimum(pmax, val_left), pmax)
+            new_min_r = jnp.where(inc, jnp.maximum(pmin, val_right), pmin)
+            new_max_r = jnp.where(dec, jnp.minimum(pmax, val_right), pmax)
+            lmin = lmin.at[leaf].set(new_min_l).at[R].set(new_min_r)
+            lmax = lmax.at[leaf].set(new_max_l).at[R].set(new_max_r)
+            mono_st = (lmin, lmax) + mono_st[2:]
+            if intermediate:
+                anc = mono_st[2]
+                anc = anc.at[R].set(anc[leaf])
+                anc = anc.at[leaf, ns].set(1).at[R, ns].set(2)
+                mono_st = (lmin, lmax, anc)
+            bounds_l = (new_min_l, new_max_l)
+            bounds_r = (new_min_r, new_max_r)
+
         # -- child best splits --
         can_go_deeper = depth_ok(new_depth)
         child_mask = None
@@ -858,6 +1009,13 @@ def _grow_compact_impl(cfg: GrowConfig,
             nb = branch[leaf] | (jnp.arange(F) == f_split)
             branch = branch.at[leaf].set(nb).at[R].set(nb)
             child_mask = allowed_features(nb)
+        mask_l = mask_r = child_mask
+        if use_bynode:
+            nm_l = node_feature_mask(2 * ns + 1)
+            nm_r = node_feature_mask(2 * ns + 2)
+            nmask_st = (nmask_st[0].at[leaf].set(nm_l).at[R].set(nm_r),)
+            mask_l = nm_l if child_mask is None else child_mask & nm_l
+            mask_r = nm_r if child_mask is None else child_mask & nm_r
         pen_l = pen_r = None
         if cegb:
             coupled_used, _, lazy_nu = cegb_st
@@ -875,14 +1033,55 @@ def _grow_compact_impl(cfg: GrowConfig,
             pen_r = cegb_penalty(nr_ex, coupled_used, right_nu)
         rl = best_for(hist_f(left_hist), best.left_sum_g[leaf],
                       best.left_sum_h[leaf], nl_ex,
-                      child_mask, pen_l)
+                      mask_l, pen_l, wl_out, new_depth, bounds_l)
         rr = best_for(hist_f(right_hist), best.right_sum_g[leaf],
                       best.right_sum_h[leaf], nr_ex,
-                      child_mask, pen_r)
+                      mask_r, pen_r, wr_out, new_depth, bounds_r)
         best = best.store(leaf, rl, can_go_deeper)
         best = best.store(R, rr, can_go_deeper)
 
-        if cegb_coupled:
+        if intermediate:
+            # refresh every leaf's bounds to the batch fixed point of
+            # the reference's cross-leaf propagation
+            # (GoUpToFindLeavesToUpdate): a leaf under a monotone
+            # ancestor is bounded by the extreme CURRENT outputs of the
+            # sibling subtree — then re-search all stored candidates.
+            lmin, lmax, anc = mono_st
+            v = tree.leaf_value
+            active = jnp.arange(L) < tree.num_leaves
+            node_mc = monotone_constraints[tree.split_feature] \
+                .astype(jnp.int32)                          # [L-1]
+            node_on = (jnp.arange(L - 1) < ns + 1) \
+                & ~tree.split_is_cat & (node_mc != 0)
+            in_l = (anc == 1) & active[:, None] & node_on[None, :]
+            in_r = (anc == 2) & active[:, None] & node_on[None, :]
+            inf_ = jnp.asarray(jnp.inf, dtype)
+            lmax_sub = jnp.max(jnp.where(in_l, v[:, None], -inf_), axis=0)
+            lmin_sub = jnp.min(jnp.where(in_l, v[:, None], inf_), axis=0)
+            rmax_sub = jnp.max(jnp.where(in_r, v[:, None], -inf_), axis=0)
+            rmin_sub = jnp.min(jnp.where(in_r, v[:, None], inf_), axis=0)
+            inc_n = (node_mc > 0)[None, :]
+            # leaf's max bound: right-subtree min (if left of an
+            # increasing node) / left-subtree min (if right of a
+            # decreasing node); min bound symmetric
+            ub = jnp.minimum(
+                jnp.min(jnp.where(in_l & inc_n, rmin_sub[None, :], inf_),
+                        axis=1),
+                jnp.min(jnp.where(in_r & ~inc_n, lmin_sub[None, :], inf_),
+                        axis=1))
+            lb = jnp.maximum(
+                jnp.max(jnp.where(in_r & inc_n, lmax_sub[None, :], -inf_),
+                        axis=1),
+                jnp.max(jnp.where(in_l & ~inc_n, rmax_sub[None, :], -inf_),
+                        axis=1))
+            mono_st = (lb, ub, anc)
+            best = research_all(tree, hists, branch, cegb_st, mono_st,
+                                nmask_st)
+
+        if cegb_coupled and not intermediate:
+            # (when intermediate monotone is on, the unconditional
+            # research_all above already re-searched under the updated
+            # coupled_used — a second pass would be identical work)
             # First use of a coupled-penalized feature erases its penalty
             # everywhere, which can promote another leaf's non-best
             # candidate to best. The reference patches the stored
@@ -890,50 +1089,24 @@ def _grow_compact_impl(cfg: GrowConfig,
             # cost_effective_gradient_boosting.hpp:100-124); we hold the
             # per-leaf histograms in HBM, so an exact re-search of every
             # leaf under the updated penalty is the same result.
-            coupled_used, _, lazy_nu = cegb_st
-
-            def research(best):
-                hf = jax.vmap(hist_f)(hists)              # [L, F, B, 2]
-                sums = hf[:, 0].sum(axis=1)               # [L, 2]
-                pens = jax.vmap(cegb_penalty,
-                                in_axes=(0, None, 0))(tree.leaf_count,
-                                                      coupled_used,
-                                                      lazy_nu)
-                masks = None if interaction_groups is None \
-                    else jax.vmap(allowed_features)(branch)
-                r = jax.vmap(best_for, in_axes=(0, 0, 0, 0,
-                                                None if masks is None
-                                                else 0, 0))(
-                    hf, sums[:, 0], sums[:, 1], tree.leaf_count,
-                    masks, pens)
-                if cfg.max_depth > 0:
-                    allowed = tree.leaf_depth < cfg.max_depth
-                else:
-                    allowed = jnp.ones((L,), jnp.bool_)
-                return _BestSplits(
-                    gain=jnp.where(allowed, r.gain, NEG_INF),
-                    feature=r.feature, threshold_bin=r.threshold_bin,
-                    default_left=r.default_left, is_cat=r.is_cat,
-                    cat_mask=r.cat_mask,
-                    left_sum_g=r.left_sum_g, left_sum_h=r.left_sum_h,
-                    left_count=r.left_count,
-                    right_sum_g=r.right_sum_g, right_sum_h=r.right_sum_h,
-                    right_count=r.right_count,
-                    left_output=r.left_output,
-                    right_output=r.right_output)
-
-            best = lax.cond(first_use, research, lambda b: b, best)
+            best = lax.cond(
+                first_use,
+                lambda b: research_all(tree, hists, branch, cegb_st,
+                                       mono_st, nmask_st),
+                lambda b: b, best)
 
         return _CompactState(tree=tree, best=best, hists=hists,
                              bins_ord=bins_ord, pay_ord=pay_ord,
                              ib_ord=ib_ord, order=order, scratch=scratch,
                              leaf_begin=lbegin, leaf_count=lcount,
                              branch=branch, num_splits=ns + 1,
-                             cegb=cegb_st)
+                             cegb=cegb_st, mono=mono_st,
+                             node_masks=nmask_st)
 
-    def forced_result(hist, tc, f, t) -> SplitResult:
+    def forced_result(hist, tc, f, t, p_out, bnds) -> SplitResult:
         """Fixed (feature, bin) split record from a leaf's histogram
-        (SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:620).
+        (SerialTreeLearner::ForceSplits, serial_tree_learner.cpp:620 via
+        GatherInfoForThresholdNumerical, feature_histogram.hpp:486).
         Missing values route right (default_left=False). ``tc`` is the
         leaf's exact count; child counts are hessian-ratio estimates
         like the regular search (feature_histogram.hpp:528)."""
@@ -947,8 +1120,18 @@ def _grow_compact_impl(cfg: GrowConfig,
         lg, lh = left[0], left[1]
         lc = jnp.round(lh * tc / jnp.maximum(th, 1e-15))
         rg, rh, rc = tg - lg, th - lh, tc - lc
-        gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p) \
-            - leaf_gain(tg, th, p)
+        if smoothing or has_mono:
+            wl = constrained_output(lg, lh, lc, p_out, bnds, p)
+            wr = constrained_output(rg, rh, rc, p_out, bnds, p)
+            # GatherInfo evaluates the parent at its stored output
+            gain = gain_at_output(lg, lh, wl, p) \
+                + gain_at_output(rg, rh, wr, p) \
+                - gain_at_output(tg, th, p_out, p)
+        else:
+            wl = leaf_output(lg, lh, p)
+            wr = leaf_output(rg, rh, p)
+            gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p) \
+                - leaf_gain(tg, th, p)
         false_ = jnp.asarray(False)
         return SplitResult(
             gain=gain.astype(dtype), feature=f, threshold_bin=t,
@@ -956,15 +1139,17 @@ def _grow_compact_impl(cfg: GrowConfig,
             cat_mask=jnp.zeros((B,), jnp.bool_),
             left_sum_g=lg, left_sum_h=lh, left_count=lc,
             right_sum_g=rg, right_sum_h=rh, right_count=rc,
-            left_output=leaf_output(lg, lh, p),
-            right_output=leaf_output(rg, rh, p))
+            left_output=wl, right_output=wr)
 
     def forced_step(state: _CompactState, ok, leaf, f, t):
         """One forced split. An invalid forced split aborts ALL
         remaining ones (abort_last_forced_split,
         serial_tree_learner.cpp:695-699), not just itself."""
+        bnds = None if not has_mono \
+            else (state.mono[0][leaf], state.mono[1][leaf])
         r = forced_result(hist_f(state.hists[leaf]),
-                          state.tree.leaf_count[leaf], f, t)
+                          state.tree.leaf_count[leaf], f, t,
+                          state.tree.leaf_value[leaf], bnds)
         valid = ok & (r.left_count > 0) & (r.right_count > 0)
         forced_state = state._replace(best=state.best.store(leaf, r,
                                                             jnp.asarray(True)))

@@ -38,6 +38,12 @@ class SplitParams(NamedTuple):
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
     min_data_per_group: float = 100.0
+    # leaf-output smoothing toward the parent's output
+    # (CalculateSplittedLeafOutput USE_SMOOTHING, feature_histogram.hpp:732)
+    path_smooth: float = 0.0
+    # depth-based gain penalty on monotone-feature splits
+    # (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:357)
+    monotone_penalty: float = 0.0
 
 
 class SplitResult(NamedTuple):
@@ -83,8 +89,51 @@ def leaf_gain(sum_g, sum_h, p: SplitParams):
     return t * t / (sum_h + p.lambda_l2 + K_EPS)
 
 
+def gain_at_output(sum_g, sum_h, w, p: SplitParams):
+    """Leaf gain evaluated at a fixed (smoothed/clamped) output
+    (GetLeafGainGivenOutput, feature_histogram.hpp)."""
+    t = _threshold_l1(sum_g, p.lambda_l1)
+    return -(2.0 * t * w + (sum_h + p.lambda_l2) * w * w)
+
+
+def smooth_output(w, cnt, parent_output, p: SplitParams):
+    """Shrink a leaf output toward its parent's:
+    ``w*(n/s)/(n/s+1) + parent/(n/s+1)`` with s = path_smooth
+    (CalculateSplittedLeafOutput USE_SMOOTHING, feature_histogram.hpp:734)."""
+    if p.path_smooth <= 0.0:
+        return w
+    a = cnt / p.path_smooth
+    return w * a / (a + 1.0) + parent_output / (a + 1.0)
+
+
+def constrained_output(sum_g, sum_h, cnt, parent_output, bounds,
+                       p: SplitParams):
+    """Optimal output, then smoothing, then monotone min/max clamp — the
+    composition order of CalculateSplittedLeafOutput<USE_MC,...>."""
+    w = leaf_output(sum_g, sum_h, p)
+    w = smooth_output(w, cnt, parent_output, p)
+    if bounds is not None:
+        w = jnp.clip(w, bounds[0], bounds[1])
+    return w
+
+
+def monotone_penalty_mult(leaf_depth, p: SplitParams):
+    """Gain multiplier for monotone-feature splits at a given depth
+    (ComputeMonotoneSplitGainPenalty, monotone_constraints.hpp:357-366)."""
+    pen = p.monotone_penalty
+    d = leaf_depth.astype(jnp.float32)
+    if pen <= 0.0:
+        return jnp.asarray(1.0, jnp.float32)
+    if pen <= 1.0:
+        base = 1.0 - pen / jnp.exp2(d) + K_EPS
+    else:
+        base = 1.0 - jnp.exp2(pen - 1.0 - d) + K_EPS
+    return jnp.where(pen >= d + 1.0, K_EPS, base)
+
+
 def _cat_split_eval(hist, parent_g, parent_h, parent_cnt,
-                    feat_num_bins, p: SplitParams):
+                    feat_num_bins, p: SplitParams,
+                    parent_output=None, bounds=None):
     """Categorical split candidates, vectorized over all features.
 
     Mirrors FindBestThresholdCategoricalInner
@@ -111,6 +160,15 @@ def _cat_split_eval(hist, parent_g, parent_h, parent_cnt,
     h3 = jnp.where(in_range[:, :, None], hist, jnp.zeros_like(hist))
     g, h, c = h3[..., 0], h3[..., 1], h3[..., 2]
     p_cat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
+    exact = p.path_smooth > 0.0 or bounds is not None
+
+    def pair_gain(lg_, lh_, lc_, rg_, rh_, rc_, pp):
+        if not exact:
+            return leaf_gain(lg_, lh_, pp) + leaf_gain(rg_, rh_, pp)
+        wl = constrained_output(lg_, lh_, lc_, parent_output, bounds, pp)
+        wr = constrained_output(rg_, rh_, rc_, parent_output, bounds, pp)
+        return gain_at_output(lg_, lh_, wl, pp) \
+            + gain_at_output(rg_, rh_, wr, pp)
 
     # ---- one-hot path (left = one category bin) ----
     rg, rh, rc = parent_g - g, parent_h - h, parent_cnt - c
@@ -121,7 +179,7 @@ def _cat_split_eval(hist, parent_g, parent_h, parent_cnt,
         & (rh >= p.min_sum_hessian_in_leaf)
         & (c > 0) & (rc > 0)
     )
-    gain_oh = leaf_gain(g, h, p) + leaf_gain(rg, rh, p)
+    gain_oh = pair_gain(g, h, c, rg, rh, rc, p)
     use_onehot = feat_num_bins <= p.max_cat_to_onehot  # [F]
     gains_oh = jnp.where(use_onehot[:, None] & valid_oh, gain_oh,
                          K_MIN_SCORE)
@@ -156,7 +214,7 @@ def _cat_split_eval(hist, parent_g, parent_h, parent_cnt,
             & (rc_ >= right_min) & (rh_ >= p.min_sum_hessian_in_leaf)
             & (lc > 0) & (rc_ > 0)
         )
-        gain = leaf_gain(lg, lh, p_cat) + leaf_gain(rg_, rh_, p_cat)
+        gain = pair_gain(lg, lh, lc, rg_, rh_, rc_, p_cat)
         return jnp.where(valid & ~use_onehot[:, None], gain, K_MIN_SCORE)
 
     gains_fwd = prefix_gains(csum_f)
@@ -175,7 +233,10 @@ def find_best_split(hist: jnp.ndarray,
                     p: SplitParams,
                     monotone_constraints: jnp.ndarray | None = None,
                     feat_is_cat: jnp.ndarray | None = None,
-                    gain_penalty: jnp.ndarray | None = None
+                    gain_penalty: jnp.ndarray | None = None,
+                    parent_output: jnp.ndarray | None = None,
+                    leaf_depth: jnp.ndarray | None = None,
+                    bounds: tuple | None = None
                     ) -> SplitResult:
     """Find the best (feature, threshold) over a leaf's histograms.
 
@@ -191,6 +252,13 @@ def find_best_split(hist: jnp.ndarray,
       monotone_constraints: optional ``[F]`` i8 in {-1, 0, +1}.
       gain_penalty: optional ``[F]`` — per-feature gain penalty (CEGB
         DeltaGain) subtracted from every candidate of that feature.
+      parent_output: scalar — the leaf's current output value, used by
+        path smoothing (GetParentOutput, serial_tree_learner.cpp:1005).
+      leaf_depth: scalar i32 — depth of the leaf, drives the
+        monotone_penalty gain multiplier.
+      bounds: optional (min, max) scalars — the leaf's monotone output
+        constraint entry (BasicConstraint); candidate outputs are
+        clamped into this interval before gains are evaluated.
 
     Returns a scalar SplitResult; ``gain`` is already shifted by the parent
     gain and min_gain_to_split (so "> 0" means worth splitting). The
@@ -225,6 +293,10 @@ def find_best_split(hist: jnp.ndarray,
     cum = jnp.cumsum(
         hist - miss_onehot[:, :, None] * nan_stats[:, None, :], axis=1)
 
+    exact = p.path_smooth > 0.0 or bounds is not None
+    p_out = jnp.asarray(0.0, dtype) if parent_output is None \
+        else parent_output
+
     def eval_dir(left: jnp.ndarray, t_valid: jnp.ndarray):
         right = total[None, None, :] - left
         lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
@@ -236,10 +308,18 @@ def find_best_split(hist: jnp.ndarray,
             & (rh >= p.min_sum_hessian_in_leaf)
             & (lc > 0) & (rc > 0)
         )
-        gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p)
+        if exact:
+            lo = constrained_output(lg, lh, lc, p_out, bounds, p)
+            ro = constrained_output(rg, rh, rc, p_out, bounds, p)
+            gain = gain_at_output(lg, lh, lo, p) \
+                + gain_at_output(rg, rh, ro, p)
+        else:
+            lo = ro = None
+            gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p)
         if monotone_constraints is not None:
-            lo = leaf_output(lg, lh, p)
-            ro = leaf_output(rg, rh, p)
+            if lo is None:
+                lo = leaf_output(lg, lh, p)
+                ro = leaf_output(rg, rh, p)
             mc = monotone_constraints[:, None]
             valid = valid & ~((mc > 0) & (lo > ro)) & ~((mc < 0) & (lo < ro))
         return jnp.where(valid, gain, K_MIN_SCORE)
@@ -265,7 +345,7 @@ def find_best_split(hist: jnp.ndarray,
         gains_l = jnp.where(num_ok, gains_l, K_MIN_SCORE)
         g_oh, g_fwd, g_bwd, csum_f, csum_b, (inv, used, participate) = \
             _cat_split_eval(hist, total[0], total[1], total[2],
-                            feat_num_bins, p)
+                            feat_num_bins, p, p_out, bounds)
         cmask = fmask & feat_is_cat[:, None]
         g_oh = jnp.where(cmask, g_oh, K_MIN_SCORE)
         g_fwd = jnp.where(cmask, g_fwd, K_MIN_SCORE)
@@ -274,12 +354,33 @@ def find_best_split(hist: jnp.ndarray,
     else:
         stacks = [gains_r, gains_l]
 
+    # shift every candidate to its NET gain before the argmax: the
+    # reference compares per-feature SplitInfo.gain values that are
+    # already ``raw - gain_shift - DeltaGain``, optionally scaled by the
+    # monotone depth penalty (ComputeBestSplitForFeature,
+    # serial_tree_learner.cpp:988-997) — the scaling changes the
+    # cross-feature ranking, so it must precede the argmax.
+    if p.path_smooth > 0.0:
+        w_parent = smooth_output(leaf_output(total[0], total[1], p),
+                                 total[2], p_out, p)
+        parent_gain = gain_at_output(total[0], total[1], w_parent, p)
+    else:
+        parent_gain = leaf_gain(total[0], total[1], p)
+    shift = parent_gain + p.min_gain_to_split
     if gain_penalty is not None:
-        stacks = [g - gain_penalty[:, None] for g in stacks]
+        nets = [g - shift - gain_penalty[:, None] for g in stacks]
+    else:
+        nets = [g - shift for g in stacks]
+    if monotone_constraints is not None and p.monotone_penalty > 0.0:
+        depth = jnp.asarray(0, jnp.int32) if leaf_depth is None \
+            else leaf_depth
+        mult = monotone_penalty_mult(depth, p).astype(dtype)
+        is_mono = (monotone_constraints != 0)[:, None]
+        nets = [jnp.where(is_mono, g * mult, g) for g in nets]
     # argmax with deterministic tie-breaking: lower (dir, feature, bin) wins
-    all_gains = jnp.stack(stacks)  # [D, F, B]
+    all_gains = jnp.stack(nets)  # [D, F, B]
     flat_idx = jnp.argmax(all_gains)
-    best_gain_raw = all_gains.reshape(-1)[flat_idx]
+    best_gain_net = all_gains.reshape(-1)[flat_idx]
     d = flat_idx // (F * B)
     f = (flat_idx // B) % F
     t = flat_idx % B
@@ -314,17 +415,26 @@ def find_best_split(hist: jnp.ndarray,
     lg, lh, lc = sel_left[0], sel_left[1], sel_left[2]
     rg, rh, rc = total[0] - lg, total[1] - lh, total[2] - lc
 
-    parent_gain = leaf_gain(total[0], total[1], p)
-    gain = best_gain_raw - parent_gain - p.min_gain_to_split
-    gain = jnp.where(jnp.isfinite(best_gain_raw), gain, K_MIN_SCORE)
+    gain = jnp.where(jnp.isfinite(best_gain_net), best_gain_net,
+                     K_MIN_SCORE)
 
     # sorted categorical splits use l2 + cat_l2 for leaf outputs
     # (feature_histogram.cpp:144 `l2 += cat_l2` before the output calc)
     p_cat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
-    lo = jnp.where(is_sorted_cat, leaf_output(lg, lh, p_cat),
-                   leaf_output(lg, lh, p))
-    ro = jnp.where(is_sorted_cat, leaf_output(rg, rh, p_cat),
-                   leaf_output(rg, rh, p))
+    if exact:
+        lo = jnp.where(
+            is_sorted_cat,
+            constrained_output(lg, lh, lc, p_out, bounds, p_cat),
+            constrained_output(lg, lh, lc, p_out, bounds, p))
+        ro = jnp.where(
+            is_sorted_cat,
+            constrained_output(rg, rh, rc, p_out, bounds, p_cat),
+            constrained_output(rg, rh, rc, p_out, bounds, p))
+    else:
+        lo = jnp.where(is_sorted_cat, leaf_output(lg, lh, p_cat),
+                       leaf_output(lg, lh, p))
+        ro = jnp.where(is_sorted_cat, leaf_output(rg, rh, p_cat),
+                       leaf_output(rg, rh, p))
 
     return SplitResult(
         gain=gain.astype(dtype),

@@ -47,7 +47,7 @@ __all__ = ["make_dp_grow_fn"]
 @functools.lru_cache(maxsize=32)
 def _build(cfg: GrowConfig, mesh: Mesh, has_monotone: bool, has_cat: bool,
            has_quant_key: bool, has_interaction: bool = False,
-           has_forced: bool = False):
+           has_forced: bool = False, has_node_key: bool = False):
     axis = mesh.axis_names[0]
     cfg = cfg._replace(axis_name=axis)
     rowspec = P(axis)
@@ -57,7 +57,8 @@ def _build(cfg: GrowConfig, mesh: Mesh, has_monotone: bool, has_cat: bool,
     in_specs = in_specs + (rep,) * (int(has_monotone) + int(has_cat)
                                     + int(has_quant_key)
                                     + int(has_interaction)
-                                    + 3 * int(has_forced))
+                                    + 3 * int(has_forced)
+                                    + int(has_node_key))
     out_specs = (rep, rowspec)  # tree replicated, row_leaf sharded
 
     def fn(bins_T, grad, hess, row_w, fmask, fnb, fnan, *rest):
@@ -66,9 +67,14 @@ def _build(cfg: GrowConfig, mesh: Mesh, has_monotone: bool, has_cat: bool,
         cat = rest.pop(0) if has_cat else None
         qkey = rest.pop(0) if has_quant_key else None
         groups = rest.pop(0) if has_interaction else None
-        forced = tuple(rest[:3]) if has_forced else None
+        forced = None
+        if has_forced:
+            forced = tuple(rest[:3])
+            rest = rest[3:]
+        nkey = rest.pop(0) if has_node_key else None
         return grow_tree_impl(cfg, bins_T, grad, hess, row_w, fmask,
-                              fnb, fnan, mono, cat, qkey, groups, forced)
+                              fnb, fnan, mono, cat, qkey, groups, forced,
+                              None, nkey)
 
     sharded = shard_map(fn, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_rep=False)
@@ -79,10 +85,11 @@ def make_dp_grow_fn(cfg: GrowConfig, mesh: Mesh,
                     has_monotone: bool = False, has_cat: bool = False,
                     has_quant_key: bool = False,
                     has_interaction: bool = False,
-                    has_forced: bool = False):
+                    has_forced: bool = False,
+                    has_node_key: bool = False):
     """Returns grow(bins_T, grad, hess, row_w, fmask, fnb, fnan[, mono]
-    [, feat_is_cat][, quant_key]) running data-parallel over ``mesh``.
-    Row inputs must be padded to a multiple of the device count (pad rows
-    carry row_weight 0)."""
+    [, feat_is_cat][, quant_key][, groups][, forced...][, node_key])
+    running data-parallel over ``mesh``. Row inputs must be padded to a
+    multiple of the device count (pad rows carry row_weight 0)."""
     return _build(cfg, mesh, has_monotone, has_cat, has_quant_key,
-                  has_interaction, has_forced)
+                  has_interaction, has_forced, has_node_key)

@@ -1,10 +1,12 @@
 """Plotting utilities.
 
-Re-design of the reference python-package/lightgbm/plotting.py
-(plot_importance, plot_split_value_histogram, plot_metric, plot_tree,
-create_tree_digraph) for the TPU-native booster. matplotlib is imported
-lazily; graphviz is optional (ImportError raised at call time, matching
-the reference's behavior).
+Covers the plotting surface of the reference
+(python-package/lightgbm/plotting.py: plot_importance,
+plot_split_value_histogram, plot_metric, plot_tree, create_tree_digraph)
+with the same signatures, but organized around a single shared
+``_decorate_axes`` helper instead of per-function axes boilerplate.
+matplotlib is imported lazily; graphviz is optional and raises at call
+time when absent, as in the reference.
 """
 
 from __future__ import annotations
@@ -20,9 +22,37 @@ __all__ = ["plot_importance", "plot_split_value_histogram", "plot_metric",
            "plot_tree", "create_tree_digraph"]
 
 
-def _check_not_tuple_of_2_elements(obj, obj_name: str) -> None:
-    if not isinstance(obj, tuple) or len(obj) != 2:
-        raise TypeError(f"{obj_name} must be a tuple of 2 elements.")
+def _pair(value, name: str) -> Tuple:
+    """Validate a 2-tuple plot bound (figsize / xlim / ylim)."""
+    if not isinstance(value, tuple) or len(value) != 2:
+        raise TypeError(f"{name} must be a tuple of 2 elements.")
+    return value
+
+
+def _new_axes(ax, figsize, dpi):
+    if ax is not None:
+        return ax
+    import matplotlib.pyplot as plt
+    if figsize is not None:
+        _pair(figsize, "figsize")
+    _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    return ax
+
+
+def _decorate_axes(ax, *, xlim=None, ylim=None, title=None, xlabel=None,
+                   ylabel=None, grid=True) -> None:
+    """Apply the common bound/label/grid decoration in one place."""
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
 
 
 def _to_booster(booster) -> Booster:
@@ -44,57 +74,43 @@ def plot_importance(booster, ax=None, height: float = 0.2,
                     ignore_zero: bool = True, figsize=None, dpi=None,
                     grid: bool = True, precision: Optional[int] = 3,
                     **kwargs):
-    """Horizontal bar plot of feature importances
-    (reference plotting.py plot_importance)."""
-    import matplotlib.pyplot as plt
-
+    """Horizontal bar plot of feature importances."""
     bst = _to_booster(booster)
     if importance_type == "auto":
         importance_type = getattr(booster, "importance_type", "split")
     importance = bst.feature_importance(importance_type=importance_type)
-    feature_name = bst.feature_name()
-
     if not len(importance):
         raise ValueError("Booster's feature_importance is empty.")
 
-    tuples = sorted(zip(feature_name, importance), key=lambda x: x[1])
+    ranked = sorted(zip(bst.feature_name(), importance),
+                    key=lambda pair: pair[1])
     if ignore_zero:
-        tuples = [x for x in tuples if x[1] > 0]
+        ranked = [pair for pair in ranked if pair[1] > 0]
     if max_num_features is not None and max_num_features > 0:
-        tuples = tuples[-max_num_features:]
-    labels, values = zip(*tuples) if tuples else ((), ())
+        ranked = ranked[-max_num_features:]
+    labels = [pair[0] for pair in ranked]
+    values = [pair[1] for pair in ranked]
 
-    if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-
-    ylocs = np.arange(len(values))
-    ax.barh(ylocs, values, align="center", height=height, **kwargs)
-    for x, y in zip(values, ylocs):
-        if importance_type == "gain" and precision is not None:
-            ax.text(x + 1, y, f"{x:.{precision}f}", va="center")
-        else:
-            ax.text(x + 1, y, str(x), va="center")
-    ax.set_yticks(ylocs)
+    ax = _new_axes(ax, figsize, dpi)
+    positions = np.arange(len(values))
+    ax.barh(positions, values, align="center", height=height, **kwargs)
+    fmt = (f"{{:.{precision}f}}"
+           if importance_type == "gain" and precision is not None
+           else "{}")
+    for pos, val in zip(positions, values):
+        ax.text(val + 1, pos, fmt.format(val), va="center")
+    ax.set_yticks(positions)
     ax.set_yticklabels(labels)
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
+        _pair(xlim, "xlim")
     else:
         xlim = (0, max(values) * 1.1 if values else 1)
-    ax.set_xlim(xlim)
     if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
+        _pair(ylim, "ylim")
     else:
         ylim = (-1, len(values))
-    ax.set_ylim(ylim)
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
+    _decorate_axes(ax, xlim=xlim, ylim=ylim, title=title, xlabel=xlabel,
+                   ylabel=ylabel, grid=grid)
     return ax
 
 
@@ -108,52 +124,40 @@ def plot_split_value_histogram(booster, feature, bins=None, ax=None,
                                ylabel: Optional[str] = "Count",
                                figsize=None, dpi=None, grid: bool = True,
                                **kwargs):
-    """Histogram of a feature's split thresholds across the model
-    (reference plotting.py plot_split_value_histogram)."""
-    import matplotlib.pyplot as plt
-
+    """Histogram of one feature's split thresholds across the model."""
     bst = _to_booster(booster)
-    names = bst.feature_name()
     if isinstance(feature, str):
-        fidx = names.index(feature)
+        fidx = bst.feature_name().index(feature)
     else:
         fidx = int(feature)
-    values = []
-    for tree in bst._models:
-        for node in range(tree.num_nodes):
-            if tree.split_feature[node] == fidx \
-                    and not tree.is_categorical_node(node):
-                values.append(tree.threshold[node])
-    if not values:
+    thresholds = [
+        tree.threshold[node]
+        for tree in bst._models
+        for node in range(tree.num_nodes)
+        if tree.split_feature[node] == fidx
+        and not tree.is_categorical_node(node)]
+    if not thresholds:
         raise ValueError(
             "Cannot plot split value histogram, "
             f"because feature {feature} was not used in splitting")
-    hist, bin_edges = np.histogram(values, bins=bins or "auto")
-    centers = (bin_edges[:-1] + bin_edges[1:]) / 2.0
-    widths = width_coef * np.diff(bin_edges)
+    counts, edges = np.histogram(thresholds, bins=bins or "auto")
+    centers = (edges[:-1] + edges[1:]) / 2.0
 
-    if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
-    ax.bar(centers, hist, width=widths, align="center", **kwargs)
+    ax = _new_axes(ax, figsize, dpi)
+    ax.bar(centers, counts, width=width_coef * np.diff(edges),
+           align="center", **kwargs)
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
-        ax.set_xlim(xlim)
+        _pair(xlim, "xlim")
     if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
+        _pair(ylim, "ylim")
     else:
-        ylim = (0, max(hist) * 1.1)
-    ax.set_ylim(ylim)
+        ylim = (0, max(counts) * 1.1)
     if title is not None:
-        title = title.replace("@feature@", str(feature)).replace(
-            "@index/name@", "name" if isinstance(feature, str) else "index")
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
+        kind = "name" if isinstance(feature, str) else "index"
+        title = title.replace("@feature@", str(feature)) \
+                     .replace("@index/name@", kind)
+    _decorate_axes(ax, xlim=xlim, ylim=ylim, title=title, xlabel=xlabel,
+                   ylabel=ylabel, grid=grid)
     return ax
 
 
@@ -165,111 +169,88 @@ def plot_metric(booster, metric: Optional[str] = None,
                 ylabel: Optional[str] = "@metric@", figsize=None, dpi=None,
                 grid: bool = True):
     """Plot metric curves from a record_evaluation dict or fitted sklearn
-    estimator (reference plotting.py plot_metric)."""
-    import matplotlib.pyplot as plt
-
+    estimator."""
     if isinstance(booster, dict):
-        eval_results = deepcopy(booster)
+        history = deepcopy(booster)
     elif hasattr(booster, "evals_result_"):
-        eval_results = deepcopy(booster.evals_result_)
+        history = deepcopy(booster.evals_result_)
     else:
         raise TypeError(
             "booster must be dict (from record_evaluation) or LGBMModel")
-    if not eval_results:
+    if not history:
         raise ValueError("eval results cannot be empty.")
 
-    if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    names = list(history.keys()) if dataset_names is None \
+        else [n for n in dataset_names if n in history]
+    if not names:
+        raise ValueError("eval results cannot be empty.")
 
-    if dataset_names is None:
-        dataset_names_iter = iter(eval_results.keys())
-    else:
-        dataset_names_iter = iter(dataset_names)
-
-    name = next(dataset_names_iter)
-    metrics_for_one = eval_results[name]
-    num_metric = len(metrics_for_one)
+    first_metrics = history[names[0]]
     if metric is None:
-        if num_metric > 1:
+        if len(first_metrics) > 1:
             raise ValueError(
                 "more than one metric available, pick one with the "
                 "'metric' parameter")
-        metric, results = metrics_for_one.popitem()
-    else:
-        if metric not in metrics_for_one:
-            raise ValueError("No given metric in eval results.")
-        results = metrics_for_one[metric]
-    num_iteration = len(results)
-    max_result = max(results)
-    min_result = min(results)
-    x_ = range(num_iteration)
-    ax.plot(x_, results, label=name)
+        metric = next(iter(first_metrics))
+    elif metric not in first_metrics:
+        raise ValueError("No given metric in eval results.")
 
-    for name in dataset_names_iter:
-        if name not in eval_results:
-            continue
-        results = eval_results[name][metric]
-        max_result = max(max(results), max_result)
-        min_result = min(min(results), min_result)
-        ax.plot(range(len(results)), results, label=name)
-
+    ax = _new_axes(ax, figsize, dpi)
+    lo, hi, length = float("inf"), float("-inf"), 0
+    for name in names:
+        curve = history[name][metric]
+        ax.plot(range(len(curve)), curve, label=name)
+        lo = min(lo, min(curve))
+        hi = max(hi, max(curve))
+        length = max(length, len(curve))
     ax.legend(loc="best")
+
     if xlim is not None:
-        _check_not_tuple_of_2_elements(xlim, "xlim")
+        _pair(xlim, "xlim")
     else:
-        xlim = (0, num_iteration)
-    ax.set_xlim(xlim)
+        xlim = (0, length)
     if ylim is not None:
-        _check_not_tuple_of_2_elements(ylim, "ylim")
+        _pair(ylim, "ylim")
     else:
-        margin = 0.05 * (max_result - min_result + 1e-12)
-        ylim = (min_result - margin, max_result + margin)
-    ax.set_ylim(ylim)
+        pad = 0.05 * (hi - lo + 1e-12)
+        ylim = (lo - pad, hi + pad)
     if ylabel is not None:
         ylabel = ylabel.replace("@metric@", metric)
-    if title is not None:
-        ax.set_title(title)
-    if xlabel is not None:
-        ax.set_xlabel(xlabel)
-    if ylabel is not None:
-        ax.set_ylabel(ylabel)
-    ax.grid(grid)
+    _decorate_axes(ax, xlim=xlim, ylim=ylim, title=title, xlabel=xlabel,
+                   ylabel=ylabel, grid=grid)
     return ax
 
 
-def _tree_label(tree, node: int, is_leaf: bool, show_info: List[str],
-                precision: int, feature_names: List[str]) -> str:
+def _node_text(tree, node: int, is_leaf: bool, show_info: List[str],
+               precision: int, feature_names: List[str]) -> str:
+    """Multi-line node label for the digraph."""
     if is_leaf:
-        parts = [f"leaf {node}",
+        lines = [f"leaf {node}",
                  f"value: {tree.leaf_value[node]:.{precision}f}"]
         if "leaf_count" in show_info:
-            parts.append(f"count: {int(tree.leaf_count[node])}")
+            lines.append(f"count: {int(tree.leaf_count[node])}")
         if "leaf_weight" in show_info:
-            parts.append(f"weight: {tree.leaf_weight[node]:.{precision}f}")
-        return "\n".join(parts)
+            lines.append(f"weight: {tree.leaf_weight[node]:.{precision}f}")
+        return "\n".join(lines)
     f = tree.split_feature[node]
     fname = feature_names[f] if f < len(feature_names) else f"f{f}"
     if tree.is_categorical_node(node):
-        dec = f"{fname} in categories"
+        lines = [f"{fname} in categories"]
     else:
-        dec = f"{fname} <= {tree.threshold[node]:.{precision}f}"
-    parts = [dec]
+        lines = [f"{fname} <= {tree.threshold[node]:.{precision}f}"]
     if "split_gain" in show_info:
-        parts.append(f"gain: {tree.split_gain[node]:.{precision}f}")
+        lines.append(f"gain: {tree.split_gain[node]:.{precision}f}")
     if "internal_value" in show_info:
-        parts.append(f"value: {tree.internal_value[node]:.{precision}f}")
+        lines.append(f"value: {tree.internal_value[node]:.{precision}f}")
     if "internal_count" in show_info:
-        parts.append(f"count: {int(tree.internal_count[node])}")
-    return "\n".join(parts)
+        lines.append(f"count: {int(tree.internal_count[node])}")
+    return "\n".join(lines)
 
 
 def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
                         precision: Optional[int] = 3,
                         orientation: str = "horizontal", **kwargs):
-    """Build a graphviz Digraph of one tree
-    (reference plotting.py create_tree_digraph)."""
+    """Build a graphviz Digraph of one tree."""
     try:
         from graphviz import Digraph
     except ImportError as e:  # pragma: no cover
@@ -286,27 +267,27 @@ def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
     precision = 3 if precision is None else precision
 
     graph = Digraph(**kwargs)
-    rankdir = "LR" if orientation == "horizontal" else "TB"
-    graph.attr("graph", nodesep="0.05", ranksep="0.3", rankdir=rankdir)
+    graph.attr("graph", nodesep="0.05", ranksep="0.3",
+               rankdir="LR" if orientation == "horizontal" else "TB")
 
     def add(node: int, parent: Optional[str]) -> None:
         if node < 0:  # leaf
             leaf = ~node
             name = f"leaf{leaf}"
-            graph.node(name, _tree_label(tree, leaf, True, show_info,
-                                         precision, feature_names))
+            graph.node(name, _node_text(tree, leaf, True, show_info,
+                                        precision, feature_names))
         else:
             name = f"split{node}"
-            graph.node(name, _tree_label(tree, node, False, show_info,
-                                         precision, feature_names))
+            graph.node(name, _node_text(tree, node, False, show_info,
+                                        precision, feature_names))
             add(int(tree.left_child[node]), name)
             add(int(tree.right_child[node]), name)
         if parent is not None:
             graph.edge(parent, name)
 
     if tree.num_leaves <= 1:
-        graph.node("leaf0", _tree_label(tree, 0, True, show_info,
-                                        precision, feature_names))
+        graph.node("leaf0", _node_text(tree, 0, True, show_info,
+                                       precision, feature_names))
     else:
         add(0, None)
     return graph
@@ -315,21 +296,15 @@ def create_tree_digraph(booster, tree_index: int = 0, show_info=None,
 def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None, dpi=None,
               show_info=None, precision: Optional[int] = 3,
               orientation: str = "horizontal", **kwargs):
-    """Render one tree with matplotlib via graphviz
-    (reference plotting.py plot_tree)."""
+    """Render one tree with matplotlib via graphviz."""
     import matplotlib.image as mpimg
-    import matplotlib.pyplot as plt
+    from io import BytesIO
 
-    if ax is None:
-        if figsize is not None:
-            _check_not_tuple_of_2_elements(figsize, "figsize")
-        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax = _new_axes(ax, figsize, dpi)
     graph = create_tree_digraph(booster, tree_index=tree_index,
                                 show_info=show_info, precision=precision,
                                 orientation=orientation, **kwargs)
-    from io import BytesIO
-    s = BytesIO(graph.pipe(format="png"))
-    img = mpimg.imread(s)
+    img = mpimg.imread(BytesIO(graph.pipe(format="png")))
     ax.imshow(img)
     ax.axis("off")
     return ax

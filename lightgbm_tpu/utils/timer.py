@@ -1,0 +1,93 @@
+"""Phase timing — the USE_TIMETAG subsystem re-imagined for JAX.
+
+The reference compiles a global ``Common::Timer`` + RAII ``FunctionTimer``
+into every hot-path phase and logs a sorted per-label wall-time table at
+process exit (/root/reference/include/LightGBM/utils/common.h:973-1057,
+instrumentation points listed in SURVEY.md §5). On TPU the device runs
+asynchronously from Python, so two complementary mechanisms are provided:
+
+- ``Timer`` / ``timed(label)``: host wall-clock aggregation per label.
+  Because dispatch is async, a label's time only reflects device work if
+  the section itself synchronizes (the train loop's per-iteration sync
+  points do). Enabled with env ``LIGHTGBM_TPU_TIMETAG=1`` or
+  ``Timer.enable()``; ``Timer.log_summary()`` prints the sorted table.
+- every timed section also enters a ``jax.profiler.TraceAnnotation`` so
+  the phases show up as named spans inside ``jax.profiler.trace``
+  captures (the tensorboard/xplane view) even when host timing is off.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict, Iterator
+
+from .log import log_info
+
+__all__ = ["Timer", "timed", "trace_to"]
+
+
+class Timer:
+    """Process-global label -> accumulated wall seconds."""
+
+    _acc: Dict[str, float] = defaultdict(float)
+    _cnt: Dict[str, int] = defaultdict(int)
+    _enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+
+    @classmethod
+    def enable(cls, on: bool = True) -> None:
+        cls._enabled = on
+
+    @classmethod
+    def enabled(cls) -> bool:
+        return cls._enabled
+
+    @classmethod
+    def add(cls, label: str, seconds: float) -> None:
+        cls._acc[label] += seconds
+        cls._cnt[label] += 1
+
+    @classmethod
+    def reset(cls) -> None:
+        cls._acc.clear()
+        cls._cnt.clear()
+
+    @classmethod
+    def summary(cls) -> Dict[str, float]:
+        return dict(cls._acc)
+
+    @classmethod
+    def log_summary(cls) -> None:
+        if not cls._acc:
+            return
+        log_info("lightgbm_tpu phase timings (host wall):")
+        for label, sec in sorted(cls._acc.items(), key=lambda kv: -kv[1]):
+            log_info(f"  {label:32s} {sec:10.3f} s  x{cls._cnt[label]}")
+
+
+@contextmanager
+def timed(label: str) -> Iterator[None]:
+    """Time a phase and annotate it for device traces."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(label):
+        if not Timer._enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            Timer.add(label, time.perf_counter() - t0)
+
+
+@contextmanager
+def trace_to(log_dir: str) -> Iterator[None]:
+    """Capture a full device trace (jax.profiler.trace wrapper) — view
+    with tensorboard's profile plugin, or any xplane.pb reader."""
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,172 @@
+"""path_smooth, feature_fraction_bynode, monotone_penalty,
+monotone_constraints_method=intermediate, auc_mu — the parameters the
+reference implements in feature_histogram.hpp (smoothing),
+col_sampler.hpp (GetByNode), monotone_constraints.hpp (penalty /
+IntermediateLeafConstraints) and multiclass_metric.hpp (AucMuMetric)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import make_synthetic_binary
+
+
+def _max_abs_leaf(bst):
+    return max(float(np.max(np.abs(t.leaf_value[: t.num_leaves])))
+               for t in bst._models)
+
+
+def _train_reg(params, X, y, rounds=5):
+    d = lgb.Dataset(X, label=y)
+    base = {"objective": "regression", "num_leaves": 15, "verbosity": -1,
+            "min_data_in_leaf": 5, "learning_rate": 1.0}
+    base.update(params)
+    return lgb.train(base, d, num_boost_round=rounds)
+
+
+def test_path_smooth_shrinks_leaf_outputs():
+    rs = np.random.RandomState(3)
+    X = rs.randn(1200, 4)
+    y = X[:, 0] * 2.0 + 0.3 * rs.randn(1200)
+    plain = _train_reg({}, X, y)
+    smooth = _train_reg({"path_smooth": 200.0}, X, y)
+    very = _train_reg({"path_smooth": 1e6}, X, y)
+    m0, m1, m2 = (_max_abs_leaf(b) for b in (plain, smooth, very))
+    # outputs shrink toward the parent chain as smoothing grows
+    assert m1 < m0
+    assert m2 < m1
+    p = smooth.predict(X)
+    assert np.all(np.isfinite(p))
+    # still learns the signal
+    assert np.corrcoef(p, y)[0, 1] > 0.8
+
+
+def test_feature_fraction_bynode_diversifies_roots():
+    rs = np.random.RandomState(5)
+    X = rs.randn(3000, 8)
+    # feature 0 dominates; with per-node sampling at 0.25 the root
+    # frequently has to split elsewhere
+    y = (X[:, 0] + 0.1 * X[:, 1] > 0).astype(float)
+    d = lgb.Dataset(X, label=y)
+    base = {"objective": "binary", "num_leaves": 8, "verbosity": -1,
+            "min_data_in_leaf": 5, "learning_rate": 0.9}
+    full = lgb.train(base, d, num_boost_round=12)
+    sub = lgb.train({**base, "feature_fraction_bynode": 0.25},
+                    lgb.Dataset(X, label=y), num_boost_round=12)
+    roots_full = {int(t.split_feature[0]) for t in full._models
+                  if t.num_nodes}
+    roots_sub = {int(t.split_feature[0]) for t in sub._models
+                 if t.num_nodes}
+    # the dominant feature owns the first root unconstrained; per-node
+    # sampling at 0.25 forces other features into root position
+    assert int(full._models[0].split_feature[0]) == 0
+    assert len(roots_sub) > max(1, len(roots_full) - 1) \
+        or not (roots_sub <= roots_full)
+    assert len(roots_sub) > 1
+    assert np.all(np.isfinite(sub.predict(X)))
+
+
+def _is_monotone(bst, X, fidx, direction, grid=9):
+    lo, hi = X[:, fidx].min(), X[:, fidx].max()
+    probe = X[:200].copy()
+    prev = None
+    for v in np.linspace(lo, hi, grid):
+        probe[:, fidx] = v
+        pred = bst.predict(probe, raw_score=True)
+        if prev is not None:
+            diff = pred - prev
+            if direction > 0 and np.min(diff) < -1e-6:
+                return False
+            if direction < 0 and np.max(diff) > 1e-6:
+                return False
+        prev = pred
+    return True
+
+
+@pytest.mark.parametrize("method", ["basic", "intermediate"])
+def test_monotone_methods_enforce_monotonicity(method):
+    rs = np.random.RandomState(11)
+    X = rs.randn(2500, 4)
+    y = (X[:, 0] + np.sin(X[:, 1] * 2) + 0.2 * rs.randn(2500) > 0) \
+        .astype(float)
+    d = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "monotone_constraints": [1, 0, 0, 0],
+                     "monotone_constraints_method": method}, d,
+                    num_boost_round=20)
+    assert _is_monotone(bst, X, 0, +1)
+
+
+def test_monotone_advanced_raises():
+    X, y = make_synthetic_binary(n=400, f=3, seed=2)
+    d = lgb.Dataset(X, label=y)
+    with pytest.raises(Exception, match="advanced"):
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "monotone_constraints": [1, 0, 0],
+                   "monotone_constraints_method": "advanced"}, d,
+                  num_boost_round=2)
+
+
+def test_monotone_penalty_defers_constrained_feature():
+    rs = np.random.RandomState(7)
+    X = rs.randn(3000, 2)
+    # f0 strongly informative (and constrained), f1 weakly informative
+    y = (X[:, 0] + 0.25 * X[:, 1] + 0.1 * rs.randn(3000) > 0).astype(float)
+    base = {"objective": "binary", "num_leaves": 4, "verbosity": -1,
+            "min_data_in_leaf": 5, "monotone_constraints": [1, 0]}
+    free = lgb.train(base, lgb.Dataset(X, label=y), num_boost_round=1)
+    pen = lgb.train({**base, "monotone_penalty": 2.0},
+                    lgb.Dataset(X, label=y), num_boost_round=1)
+    assert int(free._models[0].split_feature[0]) == 0
+    # a depth-0 monotone split is multiplied by ~kEpsilon, so the
+    # weak unconstrained feature wins the root
+    assert int(pen._models[0].split_feature[0]) == 1
+
+
+def test_auc_mu_matches_binary_auc_for_two_classes():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import AucMu, auc_jnp
+    rs = np.random.RandomState(0)
+    n = 500
+    y = (rs.rand(n) > 0.6).astype(np.float64)
+    s1 = rs.randn(n) + y * 1.2
+    score = np.stack([-s1 / 2, s1 / 2])  # [K=2, n]
+    cfg = Config(objective="multiclass", num_class=2)
+    m = AucMu(cfg)
+    got = float(m.eval(score, y, None, None))
+    want = float(auc_jnp(np.asarray(s1), np.asarray(y)))
+    assert abs(got - want) < 1e-6
+
+
+def test_auc_mu_perfect_and_random():
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.metrics import AucMu
+    rs = np.random.RandomState(1)
+    n, K = 600, 3
+    y = rs.randint(0, K, n).astype(np.float64)
+    perfect = np.zeros((K, n))
+    perfect[y.astype(int), np.arange(n)] = 5.0
+    cfg = Config(objective="multiclass", num_class=K)
+    m = AucMu(cfg)
+    assert float(m.eval(perfect, y, None, None)) == pytest.approx(1.0)
+    noise = rs.randn(K, n)
+    val = float(m.eval(noise, y, None, None))
+    assert 0.4 < val < 0.6
+
+
+def test_auc_mu_through_train_metric():
+    rs = np.random.RandomState(4)
+    X = rs.randn(900, 5)
+    y = np.argmax(X[:, :3] + 0.3 * rs.randn(900, 3), axis=1)
+    d = lgb.Dataset(X, label=y)
+    evals = {}
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "metric": "auc_mu", "verbosity": -1,
+                     "num_leaves": 8},
+                    d, num_boost_round=8, valid_sets=[d],
+                    valid_names=["train"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    curve = evals["train"]["auc_mu"]
+    assert curve[-1] > 0.8
+    assert curve[-1] >= curve[0] - 1e-9
