@@ -523,6 +523,21 @@ class Dataset:
         self.construct()
         return self._bins
 
+    def bundles(self, cfg):
+        """Exclusive-feature-bundling info (ops/bundling.py), or None
+        when bundling is off / not profitable. Cached per bin matrix
+        (subset copies recompute — the shapes differ)."""
+        self.construct()
+        if not getattr(cfg, "enable_bundle", True):
+            return None
+        cached = getattr(self, "_bundle_info", None)
+        if cached is not None and \
+                cached.bins_bundled.shape[0] == self._n:
+            return cached
+        from .ops.bundling import build_bundles
+        self._bundle_info = build_bundles(self._bins, self.mappers)
+        return self._bundle_info
+
     def device_raw(self):
         """[n, F_used] raw float32 values on device (linear trees)."""
         import jax.numpy as jnp

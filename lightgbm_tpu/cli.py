@@ -190,6 +190,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         params = parse_args(argv)
         cfg = Config.from_params(params)
+        if cfg.num_machines > 1:
+            # Network::Init analog (application.cpp:171): wire this
+            # process into the multi-controller runtime before any
+            # device work happens
+            from .parallel.distributed import init_distributed
+            init_distributed(machines=cfg.machines or None,
+                             machine_list_file=cfg.machine_list_file
+                             or None)
         task = _TASKS.get(cfg.task)
         if task is None:
             raise LightGBMError(f"Unknown task: {cfg.task}")

@@ -170,6 +170,14 @@ class GBDTBooster:
             # path smoothing, per-node column sampling and monotone
             # output-bound entries live on the compact grower
             grower = "compact"
+        if grower == "masked" and self.n * cfg.num_leaves > 50_000_000:
+            from ..utils.log import log_warning
+            log_warning(
+                "grower=masked rebuilds every histogram with a full-row "
+                "pass: O(num_leaves * rows * features) per tree "
+                f"(~{self.n * cfg.num_leaves / 1e9:.1f}B row-visits "
+                "here). Use grower=compact (the default) for data of "
+                "this size.")
         if self.monotone is not None \
                 and cfg.monotone_constraints_method == "advanced":
             raise ValueError(
@@ -212,6 +220,36 @@ class GBDTBooster:
                                   if self.monotone is not None else 0.0),
             ),
         )
+        # -- Exclusive Feature Bundling (FeatureGroup / EFB,
+        # feature_group.h:26): merge mutually-exclusive sparse features
+        # into bundle columns so the bin matrix, the histogram work and
+        # the per-leaf histogram cache all scale with #bundles ---------
+        self.bundle = None
+        self._bundle_dev = None
+        want_dp = (cfg.tree_learner in ("data", "feature", "voting")
+                   or cfg.num_devices > 1)
+        plain = (self.monotone is None and self.feat_is_cat is None
+                 and self.interaction_groups is None
+                 and self.forced is None and not self.cegb_enabled
+                 and cfg.feature_fraction_bynode >= 1.0
+                 and cfg.path_smooth <= 0.0 and not cfg.linear_tree
+                 and grower == "compact"
+                 and not (want_dp and len(jax.devices()) > 1))
+        if cfg.enable_bundle and plain:
+            binfo = ds.bundles(cfg)
+            if binfo is not None:
+                self.bundle = binfo
+                self.bins_T = jnp.asarray(binfo.bins_bundled.T)
+                self._bundle_dev = (
+                    jnp.asarray(binfo.bundle_of),
+                    jnp.asarray(binfo.offset_of),
+                    jnp.asarray(binfo.is_direct),
+                    jnp.asarray(binfo.member_at),
+                    jnp.asarray(binfo.tloc_at),
+                    jnp.asarray(binfo.end_at))
+                self.grow_cfg = self.grow_cfg._replace(
+                    bundled=True, num_bins=binfo.num_positions)
+
         # -- distributed setup: mesh instead of Network::Init ------------
         # (SURVEY.md §2.6: the socket/MPI linker layer disappears; rows
         # are sharded over a jax Mesh and XLA emits the collectives)
@@ -227,9 +265,31 @@ class GBDTBooster:
         if want_dp and ndev > 1:
             from ..parallel.data_parallel import make_dp_grow_fn
             from ..parallel.mesh import make_mesh, pad_rows
+            mode = {"feature": "feature",
+                    "voting": "voting"}.get(cfg.tree_learner, "data")
+            if mode == "voting" and (self.forced is not None
+                                     or self.cegb_enabled):
+                raise ValueError(
+                    "tree_learner=voting does not support forced splits "
+                    "or CEGB (their gathers read the local histogram "
+                    "cache as if it were global)")
+            if mode == "voting" and self.monotone is not None \
+                    and cfg.monotone_constraints_method != "basic":
+                # intermediate's all-leaves re-search reads the LOCAL
+                # histogram cache; the reference likewise forces basic
+                # in distributed mode (config.cpp:443-446)
+                from ..utils.log import log_warning
+                log_warning(
+                    "tree_learner=voting forces "
+                    "monotone_constraints_method=basic")
+                self.grow_cfg = self.grow_cfg._replace(
+                    monotone_method="basic")
+            self.grow_cfg = self.grow_cfg._replace(
+                parallel_mode=mode, voting_top_k=cfg.top_k)
             self.mesh = make_mesh(cfg.num_devices)
             D = int(self.mesh.devices.size)
-            self._pad = pad_rows(self.n, D)
+            # feature-parallel replicates rows; no shard padding needed
+            self._pad = 0 if mode == "feature" else pad_rows(self.n, D)
             if self._pad:
                 self.bins_T = jnp.pad(self.bins_T,
                                       ((0, 0), (0, self._pad)))
@@ -766,7 +826,8 @@ class GBDTBooster:
                         else jax.random.fold_in(quant_key, k),
                         self.interaction_groups, self.forced, cegb_arrays,
                         None if node_key is None
-                        else jax.random.fold_in(node_key, k))
+                        else jax.random.fold_in(node_key, k),
+                        self._bundle_dev)
                 if self.cegb_enabled:
                     dev_tree, row_leaf, self._cegb_coupled, lz = out
                     if self.cegb_lazy:

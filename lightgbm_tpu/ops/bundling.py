@@ -1,0 +1,195 @@
+"""Exclusive Feature Bundling (EFB).
+
+Re-design of the reference's FeatureGroup construction
+(/root/reference/include/LightGBM/feature_group.h:26; greedy bundling in
+src/io/dataset.cpp FindGroups/FastFeatureBundling): mutually-exclusive
+sparse features are merged into one physical column so that histogram
+construction, the partition stream, and the per-leaf histogram cache all
+scale with the number of BUNDLES instead of raw features — the "EFB"
+half of what makes LightGBM "light", mapped onto the TPU's rectangular
+[G, B] histogram layout.
+
+Bundle layout (matching the shared-zero-bin convention the reference
+uses when every member's most-frequent bin is bin 0):
+- bundle position 0      = "every member at its default (zero) bin"
+- member i with nb_i bins occupies positions [off_i, off_i + nb_i - 2],
+  storing its nonzero bins 1..nb_i-1; off accumulates (nb_i - 1).
+- a member's bin-0 statistics are reconstructed at search time as
+  ``leaf_total - sum(member range)`` — the FixHistogram /
+  most_freq_bin reconstruction (dataset.h:760) reborn as pure algebra.
+
+Eligibility: numerical features whose zero maps to bin 0 and that carry
+no NaN bin. Bundling is built host-side once at Dataset construction
+(numpy), exactly like the reference's loader-time grouping.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+__all__ = ["BundleInfo", "build_bundles"]
+
+
+class BundleInfo(NamedTuple):
+    """Host-side bundling result handed to the grower."""
+    groups: List[List[int]]       # member feature ids per bundle
+    bundle_of: np.ndarray         # [F] i32 — feature -> bundle
+    offset_of: np.ndarray         # [F] i32 — feature -> first position
+                                  #   of bin 1 inside its bundle
+    is_direct: np.ndarray         # [F] bool — singleton stored verbatim
+    bins_bundled: np.ndarray      # [n, G] u8/u16 bundle columns
+    num_positions: int            # B: max positions over bundles
+    member_at: np.ndarray         # [G, B] i32 — candidate position ->
+                                  #   member feature id (-1: none)
+    tloc_at: np.ndarray           # [G, B] i32 — position -> member-local
+                                  #   threshold bin
+    end_at: np.ndarray            # [G, B] i32 — flat [G*B] index of the
+                                  #   member's last position (range end)
+
+
+def _eligible(mappers, bins: np.ndarray) -> np.ndarray:
+    """Features that may enter a multi-member bundle: numerical, no
+    missing bin, and zero maps to bin 0 (the shared default)."""
+    from .binning import BinType, MissingType
+    F = bins.shape[1]
+    ok = np.zeros(F, bool)
+    for j, m in enumerate(mappers):
+        if m.bin_type != BinType.NUMERICAL:
+            continue
+        if m.missing_type != MissingType.NONE:
+            continue
+        if m.num_bins < 2:
+            continue
+        if int(m.value_to_bin(np.zeros(1))[0]) != 0:
+            continue
+        ok[j] = True
+    return ok
+
+
+def build_bundles(bins: np.ndarray, mappers,
+                  max_positions: int = 255,
+                  sample_rows: int = 32768,
+                  sparse_threshold: float = 0.8,
+                  seed: int = 0) -> Optional[BundleInfo]:
+    """Greedy conflict-free bundling over the binned matrix.
+
+    Only zero-conflict merges are accepted (max_conflict_rate = 0): the
+    bundled model is then EXACTLY the unbundled model, split for split.
+    Returns None when bundling would not reduce the column count.
+
+    Args:
+      bins: [n, F] host bin matrix.
+      mappers: per-feature BinMappers (eligibility checks).
+      max_positions: cap on a bundle's total positions (keeps the
+        device matrix in its narrow dtype and the histogram rectangle
+        small).
+      sparse_threshold: a feature joins a bundle only if at least this
+        fraction of sampled rows sits in its zero bin.
+    """
+    n, F = bins.shape
+    if F < 3:
+        return None
+    rs = np.random.RandomState(seed)
+    idx = rs.choice(n, size=min(n, sample_rows), replace=False) \
+        if n > sample_rows else np.arange(n)
+    sample = bins[idx]                      # [S, F]
+    nz = sample != 0                        # [S, F]
+    density = nz.mean(axis=0)
+    eligible = _eligible(mappers, bins) & (density <= 1 - sparse_threshold)
+
+    nbins = np.array([m.num_bins for m in mappers], np.int64)
+    order = np.argsort(-nz.sum(axis=0))     # dense first (reference)
+    groups: List[List[int]] = []
+    group_nz: List[np.ndarray] = []         # aggregated nonzero masks
+    group_pos: List[int] = []               # occupied positions (1 + ...)
+    for j in order:
+        if not eligible[j]:
+            continue
+        placed = False
+        width = int(nbins[j]) - 1
+        for gi in range(len(groups)):
+            if group_pos[gi] + width > max_positions:
+                continue
+            if np.any(group_nz[gi] & nz[:, j]):
+                continue                    # conflict: keep exclusive
+            groups[gi].append(int(j))
+            group_nz[gi] |= nz[:, j]
+            group_pos[gi] += width
+            placed = True
+            break
+        if not placed and width + 1 <= max_positions:
+            groups.append([int(j)])
+            group_nz.append(nz[:, j].copy())
+            group_pos.append(1 + width)
+
+    multi = [g for g in groups if len(g) > 1]
+    if not multi:
+        return None
+    bundled_members = {j for g in multi for j in g}
+    # singletons: everything else, stored verbatim ("direct" layout)
+    final_groups = multi + [[j] for j in range(F)
+                            if j not in bundled_members]
+    G = len(final_groups)
+    if G >= F:
+        return None
+
+    bundle_of = np.zeros(F, np.int32)
+    offset_of = np.zeros(F, np.int32)
+    is_direct = np.zeros(F, bool)
+    widths = []
+    for gi, g in enumerate(final_groups):
+        if len(g) == 1:
+            j = g[0]
+            bundle_of[j] = gi
+            offset_of[j] = 0
+            is_direct[j] = True
+            widths.append(int(nbins[j]))
+        else:
+            off = 1
+            for j in g:
+                bundle_of[j] = gi
+                offset_of[j] = off
+                off += int(nbins[j]) - 1
+            widths.append(off)
+    B = max(widths)
+
+    dtype = np.uint8 if B <= 256 else np.uint16
+    out = np.zeros((n, G), dtype)
+    for gi, g in enumerate(final_groups):
+        if len(g) == 1:
+            out[:, gi] = bins[:, g[0]].astype(dtype)
+        else:
+            col = np.zeros(n, np.int64)
+            for j in g:
+                bj = bins[:, j].astype(np.int64)
+                sel = bj != 0
+                col[sel] = offset_of[j] + bj[sel] - 1
+            out[:, gi] = col.astype(dtype)
+
+    member_at = np.full((G, B), -1, np.int32)
+    tloc_at = np.zeros((G, B), np.int32)
+    end_at = np.zeros((G, B), np.int32)
+    for gi, g in enumerate(final_groups):
+        if len(g) == 1:
+            j = g[0]
+            nb = int(nbins[j])
+            member_at[gi, :nb] = j
+            tloc_at[gi, :nb] = np.arange(nb)
+            end_at[gi, :nb] = gi * B + nb - 1
+        else:
+            for j in g:
+                off = int(offset_of[j])
+                nb = int(nbins[j])
+                # candidate positions off-1 .. off+nb-2 carry member
+                # thresholds t = 0 .. nb-1 (p = off-1 is the t=0
+                # "defaults left, nonzero right" cut; the previous
+                # member's own slot there is its degenerate all-left
+                # candidate, which validity pruning always discards)
+                lo, hi = off - 1, off + nb - 2
+                member_at[gi, lo:hi + 1] = j
+                tloc_at[gi, lo:hi + 1] = np.arange(nb)
+                end_at[gi, lo:hi + 1] = gi * B + off + nb - 2
+    return BundleInfo(final_groups, bundle_of, offset_of, is_direct,
+                      out, B, member_at, tloc_at, end_at)

@@ -33,7 +33,8 @@ from jax import lax
 from .histogram import (build_histogram, hist_from_rows,
                         hist_from_rows_int, subtract_histogram)
 from .split import (SplitParams, SplitResult, constrained_output,
-                    find_best_split, gain_at_output, leaf_gain, leaf_output)
+                    find_best_split, find_best_split_bundled,
+                    gain_at_output, leaf_gain, leaf_output)
 
 __all__ = ["GrowConfig", "TreeArrays", "grow_tree"]
 
@@ -88,6 +89,23 @@ class GrowConfig(NamedTuple):
     # feature_fraction_bynode (ColSampler::GetByNode, col_sampler.hpp):
     # a fresh feature subset sampled per node from the per-tree set
     bynode: float = 1.0
+    # distributed strategy under ``axis_name`` (SURVEY §2.6):
+    # "data"    — rows sharded; histograms psum-reduced
+    #             (DataParallelTreeLearner)
+    # "feature" — rows replicated; devices search disjoint feature
+    #             subsets and the winning SplitInfo is allreduced
+    #             (FeatureParallelTreeLearner; on TPU the fused MXU
+    #             histogram still covers all features — the sharing is
+    #             in the split search, see best_for)
+    # "voting"  — rows sharded; each device proposes its local top-k
+    #             features, a global vote elects 2k, and only elected
+    #             features' histograms are globally reduced
+    #             (VotingParallelTreeLearner / PV-Tree)
+    parallel_mode: str = "data"
+    voting_top_k: int = 20
+    # Exclusive Feature Bundling (ops/bundling.py): bins_T holds bundle
+    # columns and the split search runs in bundle-position space
+    bundled: bool = False
 
 
 class TreeArrays(NamedTuple):
@@ -264,7 +282,8 @@ def grow_tree_impl(cfg: GrowConfig,
                    interaction_groups: Optional[jnp.ndarray] = None,
                    forced: Optional[tuple] = None,
                    cegb_arrays: Optional[tuple] = None,
-                   node_key: Optional[jnp.ndarray] = None):
+                   node_key: Optional[jnp.ndarray] = None,
+                   bundle_arrays: Optional[tuple] = None):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf)
     (+ (coupled_used, lazy_used) when cfg.cegb).
 
@@ -289,7 +308,10 @@ def grow_tree_impl(cfg: GrowConfig,
                                   feature_mask, feat_num_bins, feat_nan_bin,
                                   monotone_constraints, feat_is_cat,
                                   quant_key, interaction_groups, forced,
-                                  cegb_arrays, node_key)
+                                  cegb_arrays, node_key, bundle_arrays)
+    if cfg.bundled:
+        raise NotImplementedError(
+            "EFB bundling requires the compact grower")
     if interaction_groups is not None or forced is not None \
             or cegb_arrays is not None:
         raise NotImplementedError(
@@ -433,13 +455,18 @@ class _CompactState(NamedTuple):
     tree: TreeArrays
     best: _BestSplits
     hists: jnp.ndarray       # [L, F, B, 2] (sum_grad, sum_hess)
-    bins_ord: jnp.ndarray    # [n+K, F] u8/u16 — bin rows grouped by leaf
-    pay_ord: jnp.ndarray     # [n+K, 2] f32/i8 — (g, h) payload, same order
-    ib_ord: jnp.ndarray      # [n+K] bool — in-bag flags, same order
-    order: jnp.ndarray       # [n+K] i32 — original row ids, same order
-    scratch: tuple           # 8 same-shape partition scratch windows
-                             # (L/R x bins/pay/ib/order); contents are
-                             # per-split scratch, never reset
+    bins2: jnp.ndarray       # [2*(n+2K), F] u8/u16 — two ping-pong
+                             # halves laid out flat; half b's window
+                             # positions start at b*(n+2K) + K (K rows
+                             # of pad on both sides of each half absorb
+                             # full-chunk write tails)
+    pay2: jnp.ndarray        # [2*(n+2K), 2] f32/i8 — (g, h) payload
+    ord2: jnp.ndarray        # [2*(n+2K)] u32 — original row id, top
+                             # bit = in-bag flag
+    leaf_buf: jnp.ndarray    # [L] i32 — which half (0/1) holds each
+                             # leaf's window; the left child stays in
+                             # the parent's half, the right child moves
+                             # to the other
     leaf_begin: jnp.ndarray  # [L] i32 (local raw offsets)
     leaf_count: jnp.ndarray  # [L] i32 (local raw counts)
     branch: jnp.ndarray      # [L, F] bool — features used on leaf's path
@@ -455,13 +482,13 @@ class _CompactState(NamedTuple):
                              # sets when cfg.bynode < 1
 
 
-def _row_leaf_from_order(order, leaf_begin, leaf_count, n, L):
-    """Recover the per-row leaf assignment from the grouped order:
-    ranges partition [0, n); mark each active range start, prefix-sum to
-    a segment id, map segments to leaves via the begin-sorted leaf list.
-    The final positional->row-id inversion runs as a variadic sort (a
-    vectorized sorting network) rather than a scatter, which XLA:TPU
-    serializes per element."""
+_IB_BIT = jnp.uint32(1 << 31)
+
+
+def _leaf_of_positions(leaf_begin, leaf_count, n, L):
+    """[n] leaf id per grouped position: ranges partition [0, n); mark
+    each active range start, prefix-sum to a segment id, map segments to
+    leaves via the begin-sorted leaf list."""
     active = leaf_count > 0
     keys = jnp.where(active, leaf_begin, n + 1)
     ls = jnp.argsort(keys)  # leaves ordered by begin, inactive last
@@ -469,7 +496,13 @@ def _row_leaf_from_order(order, leaf_begin, leaf_count, n, L):
     marks = jnp.zeros((n,), jnp.int32).at[
         jnp.clip(leaf_begin[ls], 0, n - 1)].add(flag)
     seg = jnp.cumsum(marks) - 1
-    leaf_of_pos = ls[jnp.clip(seg, 0, L - 1)].astype(jnp.int32)
+    return ls[jnp.clip(seg, 0, L - 1)].astype(jnp.int32)
+
+
+def _row_leaf_from_order(order, leaf_of_pos):
+    """Positional->row-id inversion as a variadic sort (a vectorized
+    sorting network) rather than a scatter, which XLA:TPU serializes
+    per element."""
     _, row_leaf = lax.sort((order, leaf_of_pos), num_keys=1)
     return row_leaf
 
@@ -488,7 +521,8 @@ def _grow_compact_impl(cfg: GrowConfig,
                        interaction_groups: Optional[jnp.ndarray] = None,
                        forced: Optional[tuple] = None,
                        cegb_arrays: Optional[tuple] = None,
-                       node_key: Optional[jnp.ndarray] = None):
+                       node_key: Optional[jnp.ndarray] = None,
+                       bundle_arrays: Optional[tuple] = None):
     """Leaf-wise growth with rows kept PHYSICALLY grouped by leaf.
 
     The reference's DataPartition (data_partition.hpp) + CUDA partition
@@ -500,9 +534,13 @@ def _grow_compact_impl(cfg: GrowConfig,
     gathers (TPU gathers serialize per element) and no ``lax.switch``
     over window sizes (XLA copies big conditional operands; while-loop
     carries alias in place). Histograms ride the MXU via the nibble
-    decomposition (histogram.py); the partition is a two-pass stable
-    compaction (count, then permute-to-scratch + copy-back) — the CUDA
-    bit-vector + prefix-sum pattern."""
+    decomposition (histogram.py). The partition is a SINGLE streaming
+    pass per split: each chunk is sort-partitioned in registers and its
+    left/right runs are appended (masked RMW) into the opposite buffer
+    of a leading-axis ping-pong pair, with the child histogram
+    accumulated from the same resident chunk — the CUDA bit-vector +
+    prefix-sum + histogram kernels (cuda_data_partition.cu,
+    cuda_histogram_constructor.cu) fused into one data movement."""
     L = cfg.num_leaves
     B = cfg.num_bins
     F = bins_T.shape[0]
@@ -514,22 +552,134 @@ def _grow_compact_impl(cfg: GrowConfig,
         K //= 2
     K = max(K, 256)
 
+    fp = cfg.axis_name is not None and cfg.parallel_mode == "feature"
+    vp = cfg.axis_name is not None and cfg.parallel_mode == "voting"
+
     def psum(x):
-        return lax.psum(x, cfg.axis_name) if cfg.axis_name else x
+        """Row-sharded reduction; identity in feature-parallel mode
+        (rows are replicated there)."""
+        if cfg.axis_name is None or fp:
+            return x
+        return lax.psum(x, cfg.axis_name)
+
+    def hist_psum(x):
+        """Histogram reduction: identity for feature-parallel (every
+        device holds all rows, so a local histogram is already global)
+        AND for voting (the cache stays local; the reduction happens
+        per-search over elected features only)."""
+        if cfg.axis_name is None or fp or vp:
+            return x
+        return lax.psum(x, cfg.axis_name)
 
     has_mono = monotone_constraints is not None
     intermediate = has_mono and cfg.monotone_method == "intermediate"
     use_bynode = cfg.bynode < 1.0 and node_key is not None
     smoothing = p.path_smooth > 0.0
 
+    bundled = cfg.bundled and bundle_arrays is not None
+    if bundled:
+        if (cfg.cegb or interaction_groups is not None
+                or forced is not None
+                or has_mono or use_bynode or smoothing
+                or feat_is_cat is not None or cfg.axis_name is not None):
+            raise NotImplementedError(
+                "EFB bundling currently supports plain single-device "
+                "training only (gbdt.py gates the combinations)")
+        (bundle_of, offset_of, bundle_is_direct, member_at, tloc_at,
+         end_at) = bundle_arrays
+
+    def _fp_combine(r: SplitResult) -> SplitResult:
+        """SyncUpGlobalBestSplit (parallel_tree_learner.h:209-232):
+        allreduce the max-gain SplitInfo across the disjoint feature
+        shards; ties resolve to the lower feature id (SplitInfo total
+        order, split_info.hpp)."""
+        ax = cfg.axis_name
+        gmax = lax.pmax(r.gain, ax)
+        at_max = r.gain >= gmax
+        packed = jnp.where(at_max, r.feature, jnp.int32(2 ** 30))
+        fwin = lax.pmin(packed, ax)
+        win = at_max & (r.feature == fwin)
+        cnt = lax.psum(win.astype(jnp.float32), ax)
+
+        def bc(x):
+            xf = x.astype(jnp.float32)
+            mean = lax.psum(jnp.where(win, xf, 0.0), ax) / cnt
+            if x.dtype == jnp.bool_:
+                return mean > 0.5
+            if jnp.issubdtype(x.dtype, jnp.integer):
+                return jnp.round(mean).astype(x.dtype)
+            return mean.astype(x.dtype)
+
+        return SplitResult(*(bc(field) for field in r))
+
     def best_for(hist, sg, sh, sc, extra_mask=None, gain_penalty=None,
                  parent_output=None, depth=None, bounds=None):
         fmask = feature_mask if extra_mask is None \
             else feature_mask & extra_mask
-        return find_best_split(hist, sg, sh, sc, feat_num_bins, feat_nan_bin,
-                               fmask, p, monotone_constraints,
-                               feat_is_cat, gain_penalty, parent_output,
-                               depth, bounds)
+        if bundled:
+            return find_best_split_bundled(hist, sg, sh, sc, member_at,
+                                           tloc_at, end_at,
+                                           bundle_is_direct, fmask, p)
+        if fp:
+            # disjoint round-robin feature ownership; each device
+            # searches its own columns, then the global best SplitInfo
+            # is allreduced (FeatureParallelTreeLearner, feature_
+            # parallel_tree_learner.cpp:71 — rows are replicated, so
+            # histograms need no reduction; the TPU's fused MXU
+            # histogram still covers all features, the sharding lives
+            # in the split search)
+            dev = lax.axis_index(cfg.axis_name)
+            ndev = lax.axis_size(cfg.axis_name)
+            own = (jnp.arange(F) % ndev) == dev
+            r = find_best_split(hist, sg, sh, sc, feat_num_bins,
+                                feat_nan_bin, fmask & own, p,
+                                monotone_constraints, feat_is_cat,
+                                gain_penalty, parent_output, depth,
+                                bounds)
+            return _fp_combine(r)
+        if vp:
+            # PV-Tree (VotingParallelTreeLearner, voting_parallel_tree_
+            # learner.cpp:364): local top-k ballot over per-feature best
+            # gains -> global election of 2k features -> reduce only the
+            # elected histograms -> one global search over them. The
+            # reduction here is a masked full-width psum (exchanging
+            # just the elected rows is a DCN-mesh optimization).
+            ax = cfg.axis_name
+            # the ballot judges LOCAL histograms, so it must use local
+            # leaf sums and shard-scaled data constraints (the
+            # reference's local_config_, voting_parallel_tree_learner
+            # .cpp:61-63)
+            ndev = lax.axis_size(ax)
+            lh_tot = jnp.sum(hist[0], axis=0)   # feature 0 sees all rows
+            sg_loc, sh_loc = lh_tot[0], lh_tot[1]
+            sc_loc = jnp.round(sc * sh_loc / jnp.maximum(sh, 1e-15))
+            p_loc = p._replace(
+                min_data_in_leaf=p.min_data_in_leaf / ndev,
+                min_sum_hessian_in_leaf=p.min_sum_hessian_in_leaf / ndev)
+            _, fgains = find_best_split(
+                hist, sg_loc, sh_loc, sc_loc, feat_num_bins,
+                feat_nan_bin, fmask, p_loc,
+                monotone_constraints, feat_is_cat, gain_penalty,
+                parent_output, depth, bounds, return_feature_gains=True)
+            k = min(cfg.voting_top_k, F)
+            kth = jnp.sort(fgains)[F - k]
+            ballot = jnp.isfinite(fgains) & (fgains >= kth)
+            votes = lax.psum(ballot.astype(jnp.int32), ax)
+            k2 = min(2 * cfg.voting_top_k, F)
+            score = votes * F + (F - 1 - jnp.arange(F))
+            elected = score >= jnp.sort(score)[F - k2]
+            ghist = lax.psum(
+                hist * elected[:, None, None].astype(hist.dtype), ax)
+            return find_best_split(ghist, sg, sh, sc, feat_num_bins,
+                                   feat_nan_bin, fmask & elected, p,
+                                   monotone_constraints, feat_is_cat,
+                                   gain_penalty, parent_output, depth,
+                                   bounds)
+        return find_best_split(hist, sg, sh, sc, feat_num_bins,
+                               feat_nan_bin, fmask, p,
+                               monotone_constraints, feat_is_cat,
+                               gain_penalty, parent_output, depth,
+                               bounds)
 
     def node_feature_mask(idx):
         """Per-node feature subset (ColSampler::GetByNode): rank a fresh
@@ -596,7 +746,9 @@ def _grow_compact_impl(cfg: GrowConfig,
             / max(1, cfg.quant_bins)
         if cfg.stochastic and quant_key is not None:
             k = quant_key
-            if cfg.axis_name:
+            if cfg.axis_name and not fp:
+                # feature-parallel replicates rows: every device must
+                # round identically
                 k = jax.random.fold_in(k, lax.axis_index(cfg.axis_name))
             u = jax.random.uniform(k, (n, 2), dtype)
         else:
@@ -631,6 +783,20 @@ def _grow_compact_impl(cfg: GrowConfig,
     def chunk_goleft(blk_b, f, t, dl, isc, cm):
         """go-left decision for one chunk — all vector ops (a cm[col]
         table gather would serialize per element on TPU)."""
+        if bundled:
+            # the split references an ORIGINAL feature; resolve it to
+            # its bundle column + member range (ops/bundling.py layout)
+            g = bundle_of[f]
+            off = offset_of[f]
+            nb = feat_num_bins[f]
+            gsel = jnp.arange(F) == g      # F == #bundle columns here
+            col = jnp.max(jnp.where(gsel[None, :], blk_b, 0),
+                          axis=1).astype(jnp.int32)
+            left_direct = col <= t
+            # member bins > t occupy positions [off + t, off + nb - 2]
+            right_multi = (col >= off + t) & (col <= off + nb - 2)
+            return jnp.where(bundle_is_direct[f], left_direct,
+                             ~right_multi)
         fsel = jnp.arange(F) == f
         col = jnp.max(jnp.where(fsel[None, :], blk_b, 0),
                       axis=1).astype(jnp.int32)
@@ -664,34 +830,87 @@ def _grow_compact_impl(cfg: GrowConfig,
                                      (s, 0), (K, a.shape[1]))
         return lax.dynamic_slice(jnp.concatenate([a, a]), (s,), (K,))
 
-    def part_apply(bins_ord, pay_ord, ib_ord, order, lazy_used, scratch,
-                   start, cnt, f, t, dl, isc, cm):
-        """Stable two-way window compaction + smaller-child histogram,
-        streaming K-row chunks.
+    if quant:
+        # int8 (g, h) pairs ride the sort as ONE u16 column
+        def _pack_pay(blk_p):
+            return (lax.bitcast_convert_type(
+                blk_p.reshape(K, 1, 2), jnp.uint16)[:, 0],)
 
-        Pass B sorts each chunk by a stable (side, position) key — the
-        TPU's one fast data-movement primitive (a vectorized sorting
-        network; gathers/scatters serialize per element) — and appends
-        the left/right runs to two scratch windows with telescoping
-        full-chunk writes (each write's garbage tail is overwritten by
-        the next; final tails land in scratch padding). Pass C merges
-        scratchL[0, n_left) ++ scratchR[0, n_right) back over the
-        window, and accumulates the smaller child's histogram from the
-        merged chunks on the way through (one streaming pass serves
-        both). The CUDA analog is GenDataToLeftBitVector + prefix-sum
-        compaction (cuda_data_partition.cu) + ConstructHistogramForLeaf
-        (cuda_histogram_constructor.cu)."""
-        sbL, spL, siL, soL, sbR, spR, siR, soR = scratch
+        def _unpack_pay(cols):
+            return lax.bitcast_convert_type(cols[0][:, None],
+                                            jnp.int8).reshape(K, 2)
+        NPAY = 1
+    else:
+        def _pack_pay(blk_p):
+            return (blk_p[:, 0], blk_p[:, 1])
+
+        def _unpack_pay(cols):
+            return jnp.stack(cols, axis=1)
+        NPAY = 2
+
+    SEG = n + 2 * K  # rows per ping-pong half (K pad on both sides)
+
+    def part_apply(bins2, pay2, ord2, lazy_used, src, start, cnt,
+                   f, t, dl, isc, cm, est_left_small):
+        """Stable two-way window compaction + child histogram in ONE
+        streaming pass over the leaf's window.
+
+        The two ping-pong halves live in one flat array; the half
+        choice is plain row-offset arithmetic (``b*SEG + K``), so every
+        access is the dynamic-row-slice pattern XLA:TPU aliases well —
+        no conditional branches, no dynamic major-axis indexing.
+
+        Each K-row chunk is read from the source half, partitioned
+        in-registers by a variadic sort on a (side, position) key — the
+        TPU's one fast data-movement primitive (gathers/scatters
+        serialize per element) — then:
+        - LEFT runs append forward IN PLACE in the source half (safely
+          behind the read frontier: l_off + K <= (c+1)K);
+        - RIGHT runs pack backward from ``start + cnt`` in the OTHER
+          half (dead space: window ranges partition [0, n) and only one
+          half per range is live).
+        Both writes are masked read-modify-writes: a full-chunk block's
+        garbage lanes would otherwise spill across the window edge into
+        a NEIGHBORING leaf's live rows whenever cnt is not K-aligned.
+        The left child therefore stays in the parent's half and the
+        right child lands in the opposite half (leaf_buf tracks this).
+        The histogram of the (estimated-)smaller child is accumulated
+        from the same resident chunk before the sort — the sibling
+        follows by subtraction. The CUDA analog is
+        GenDataToLeftBitVector + prefix-sum compaction
+        (cuda_data_partition.cu) + ConstructHistogramForLeaf
+        (cuda_histogram_constructor.cu), fused into one data movement.
+
+        ``est_left_small`` picks the histogrammed side from the stored
+        SplitInfo's count estimates — decided before streaming (the
+        reference re-checks with exact counts, but exact counts only
+        exist after the pass; estimates are deterministic and
+        replicated across shards).
+        """
+        src_base = src * SEG + K + start
+        dst_base = (1 - src) * SEG + K + start
         zero = jnp.asarray(0, jnp.int32)
+        acc0 = jnp.zeros((F, B, C), jnp.int32 if quant else dtype)
 
-        def body_b(c, carry):
-            (sbL, spL, siL, soL, sbR, spR, siR, soR, lazy_used,
+        def write(arr, off, block, m):
+            """Masked RMW block write at a dynamic row offset."""
+            if arr.ndim == 2:
+                cur = lax.dynamic_slice(arr, (off, 0),
+                                        (K, arr.shape[1]))
+                out = jnp.where(m[:, None], block, cur)
+                return lax.dynamic_update_slice(arr, out, (off, 0))
+            cur = lax.dynamic_slice(arr, (off,), (K,))
+            out = jnp.where(m, block, cur)
+            return lax.dynamic_update_slice(arr, out, (off,))
+
+        def body(c, carry):
+            (bins2, pay2, ord2, lazy_used, hist, nu,
              l_off, r_off, nlib, nib) = carry
-            pos0 = start + c * K
-            blk_b = lax.dynamic_slice(bins_ord, (pos0, 0), (K, F))
-            blk_p = lax.dynamic_slice(pay_ord, (pos0, 0), (K, C))
-            blk_i = lax.dynamic_slice(ib_ord, (pos0,), (K,))
-            blk_o = lax.dynamic_slice(order, (pos0,), (K,))
+            pos0 = src_base + c * K
+            blk_b = lax.dynamic_slice(bins2, (pos0, 0), (K, F))
+            blk_p = lax.dynamic_slice(pay2, (pos0, 0), (K, C))
+            blk_o = lax.dynamic_slice(ord2, (pos0,), (K,))
+            blk_i = (blk_o & _IB_BIT) != 0
             gl = chunk_goleft(blk_b, f, t, dl, isc, cm)
             valid = iota_k < jnp.clip(cnt - c * K, 0, K)
             vl = valid & gl
@@ -699,123 +918,79 @@ def _grow_compact_impl(cfg: GrowConfig,
             r_c = jnp.sum((valid & ~gl).astype(jnp.int32))
             nlib += jnp.sum((vl & blk_i).astype(jnp.int32))
             nib += jnp.sum((valid & blk_i).astype(jnp.int32))
+            # histogram of the estimated-smaller side, from the chunk
+            # already in registers (pre-sort; order is irrelevant)
+            hmask = jnp.where(est_left_small, vl, valid & ~gl)
+            if quant:
+                hp = blk_p * hmask[:, None].astype(jnp.int8)
+                hist = hist + hist_from_rows_int(blk_b, hp, B, hmethod)
+            else:
+                hp = blk_p * hmask[:, None].astype(dtype)
+                hist = hist + hist_from_rows(blk_b, hp, B, hmethod,
+                                             cfg.hist_precision)
+            if cegb_lazy:
+                rows = (blk_o & ~_IB_BIT).astype(jnp.int32)
+                used_rows = jnp.take(lazy_used, rows, axis=0)   # [K, F]
+                nu = nu + jnp.sum((hmask & blk_i)[:, None] & ~used_rows,
+                                  axis=0).astype(dtype)
+                # the split acquires feature f for every in-bag row in
+                # the leaf (UpdateLeafBestSplits' InsertBitset loop
+                # over the bagged partition)
+                lazy_used = lazy_used.at[rows, f].max(valid & blk_i)
             # stable in-chunk partition: one variadic sort moving all
             # row data by a (side, position) key
             side = jnp.where(vl, 0, jnp.where(valid, 1, 2))
             key = side * K + iota_k
             ops = lax.sort((key,) + _pack_bins(blk_b)
-                           + (blk_p[:, 0], blk_p[:, 1], blk_i, blk_o),
-                           num_keys=1)
+                           + _pack_pay(blk_p) + (blk_o,), num_keys=1)
             pb = _unpack_bins(ops[1:1 + NW])
-            pp = jnp.stack(ops[1 + NW:3 + NW], axis=1)
-            pi = ops[3 + NW]
-            po = ops[4 + NW]
-            # rights start at row l_c; align them to 0 for the R append
-            rK = K - l_c
-            sbL = lax.dynamic_update_slice(sbL, pb, (l_off, 0))
-            sbR = lax.dynamic_update_slice(sbR, rot(pb, K - rK), (r_off, 0))
-            spL = lax.dynamic_update_slice(spL, pp, (l_off, 0))
-            spR = lax.dynamic_update_slice(spR, rot(pp, K - rK), (r_off, 0))
-            siL = lax.dynamic_update_slice(siL, pi, (l_off,))
-            siR = lax.dynamic_update_slice(siR, rot(pi, K - rK), (r_off,))
-            soL = lax.dynamic_update_slice(soL, po, (l_off,))
-            soR = lax.dynamic_update_slice(soR, rot(po, K - rK), (r_off,))
-            if cegb_lazy:
-                # the split acquires feature f for every in-bag row in
-                # the leaf (UpdateLeafBestSplits' InsertBitset loop over
-                # the bagged partition)
-                lazy_used = lazy_used.at[blk_o, f].max(valid & blk_i)
-            return (sbL, spL, siL, soL, sbR, spR, siR, soR, lazy_used,
+            pp = _unpack_pay(ops[1 + NW:1 + NW + NPAY])
+            po = ops[1 + NW + NPAY]
+            # lefts [0, l_c) forward in place
+            ml = iota_k < l_c
+            bins2 = write(bins2, src_base + l_off, pb, ml)
+            pay2 = write(pay2, src_base + l_off, pp, ml)
+            ord2 = write(ord2, src_base + l_off, po, ml)
+            # rights [l_c, l_c+r_c) rotated to the block END, packed
+            # backward from the window end in the other half
+            s_r = lax.rem(l_c + r_c, jnp.asarray(K, jnp.int32))
+            o_r = dst_base + cnt - r_off - K
+            mr = iota_k >= (K - r_c)
+            bins2 = write(bins2, o_r, rot(pb, s_r), mr)
+            pay2 = write(pay2, o_r, rot(pp, s_r), mr)
+            ord2 = write(ord2, o_r, rot(po, s_r), mr)
+            return (bins2, pay2, ord2, lazy_used, hist, nu,
                     l_off + l_c, r_off + r_c, nlib, nib)
 
-        (sbL, spL, siL, soL, sbR, spR, siR, soR, lazy_used, n_left, _,
+        (bins2, pay2, ord2, lazy_used, est_hist, est_nu, n_left, _,
          n_left_ib, n_ib) = lax.fori_loop(
-            0, window_chunks(cnt), body_b,
-            (sbL, spL, siL, soL, sbR, spR, siR, soR, lazy_used,
+            0, window_chunks(cnt), body,
+            (bins2, pay2, ord2, lazy_used, acc0, jnp.zeros((F,), dtype),
              zero, zero, zero, zero))
 
         # exact global in-bag child counts replace the search-time
         # hessian-ratio estimates (SplitInner update_cnt,
-        # serial_tree_learner.cpp:789-791); "smaller" is decided on
-        # GLOBAL counts so every shard histograms the same side
-        # (SyncUpGlobalBestSplit determinism)
+        # serial_tree_learner.cpp:789-791)
         nl_ex = psum(n_left_ib).astype(dtype)
         nr_ex = psum(n_ib - n_left_ib).astype(dtype)
-        left_smaller = nl_ex <= nr_ex
-        s_lo = jnp.where(left_smaller, 0, n_left)
-        s_hi_end = jnp.where(left_smaller, n_left, cnt)
-
-        def merge_piece(arrL, arrR, c):
-            off = c * K
-            shift = jnp.clip(n_left - off, 0, K)
-            r0 = jnp.clip(off - n_left, 0, n)
-            if arrL.ndim == 2:
-                cL = lax.dynamic_slice(arrL, (off, 0), (K, arrL.shape[1]))
-                cR = rot(lax.dynamic_slice(arrR, (r0, 0),
-                                           (K, arrL.shape[1])), K - shift)
-                return jnp.where((iota_k < shift)[:, None], cL, cR)
-            cL = lax.dynamic_slice(arrL, (off,), (K,))
-            cR = rot(lax.dynamic_slice(arrR, (r0,), (K,)), K - shift)
-            return jnp.where(iota_k < shift, cL, cR)
-
-        def write(arr, piece, c):
-            off = c * K
-            m = jnp.clip(cnt - off, 0, K)
-            w = start + off
-            if arr.ndim == 2:
-                cur = lax.dynamic_slice(arr, (w, 0), (K, arr.shape[1]))
-                out = jnp.where((iota_k < m)[:, None], piece, cur)
-                return lax.dynamic_update_slice(arr, out, (w, 0))
-            cur = lax.dynamic_slice(arr, (w,), (K,))
-            out = jnp.where(iota_k < m, piece, cur)
-            return lax.dynamic_update_slice(arr, out, (w,))
-
-        acc0 = jnp.zeros((F, B, C), jnp.int32 if quant else dtype)
-
-        def body_c(c, carry):
-            bins_ord, pay_ord, ib_ord, order, hist, nu = carry
-            pb = merge_piece(sbL, sbR, c)
-            pp = merge_piece(spL, spR, c)
-            pi = merge_piece(siL, siR, c)
-            po = merge_piece(soL, soR, c)
-            # smaller-child histogram from the merged rows, on the way
-            # through (saves a third streaming pass over the window)
-            gpos = c * K + iota_k
-            hmask = (gpos >= s_lo) & (gpos < s_hi_end)
-            if cegb_lazy:
-                used_rows = jnp.take(lazy_used, po, axis=0)     # [K, F]
-                nu = nu + jnp.sum((hmask & pi)[:, None] & ~used_rows,
-                                  axis=0).astype(dtype)
-            if quant:
-                hp = pp * hmask[:, None].astype(jnp.int8)
-                hist = hist + hist_from_rows_int(pb, hp, B, hmethod)
-            else:
-                hp = pp * hmask[:, None].astype(dtype)
-                hist = hist + hist_from_rows(pb, hp, B, hmethod,
-                                             cfg.hist_precision)
-            return (write(bins_ord, pb, c), write(pay_ord, pp, c),
-                    write(ib_ord, pi, c), write(order, po, c), hist, nu)
-
-        bins_ord, pay_ord, ib_ord, order, small_hist, small_nu = \
-            lax.fori_loop(0, window_chunks(cnt), body_c,
-                          (bins_ord, pay_ord, ib_ord, order, acc0,
-                           jnp.zeros((F,), dtype)))
-        scratch = (sbL, spL, siL, soL, sbR, spR, siR, soR)
-        return (bins_ord, pay_ord, ib_ord, order, lazy_used, scratch,
-                n_left, nl_ex, nr_ex, left_smaller, psum(small_hist),
-                small_nu)
+        return (bins2, pay2, ord2, lazy_used, n_left, nl_ex, nr_ex,
+                hist_psum(est_hist), est_nu)
 
     # ---- root ----
     total_c = psum(jnp.sum(inbag.astype(dtype)))
     if quant:
-        root_hist = psum(hist_from_rows_int(bins_rm, gw2_q, B, hmethod))
+        root_hist = hist_psum(hist_from_rows_int(bins_rm, gw2_q, B,
+                                                 hmethod))
         sums = hist_f(root_hist)[0].sum(axis=0)  # every row hits feature 0
+        if vp:
+            # voting keeps the cache local; the root tuple is global
+            sums = lax.psum(sums, cfg.axis_name)
         total_g, total_h = sums[0], sums[1]
     else:
         total_g = psum(jnp.sum(gw2[:, 0]))
         total_h = psum(jnp.sum(gw2[:, 1]))
-        root_hist = psum(hist_from_rows(bins_rm, gw2, B, hmethod,
-                                        cfg.hist_precision))
+        root_hist = hist_psum(hist_from_rows(bins_rm, gw2, B, hmethod,
+                                             cfg.hist_precision))
 
     tree = _init_tree(L, B, dtype)
     tree = tree._replace(
@@ -868,17 +1043,14 @@ def _grow_compact_impl(cfg: GrowConfig,
     hists = jnp.zeros((L, F, B, 2),
                       jnp.int32 if quant else dtype).at[0].set(root_hist)
     pay0 = gw2_q if quant else gw2
-    scratch0 = (jnp.zeros((n + K, F), bins_rm.dtype),
-                jnp.zeros((n + K, C), pay0.dtype),
-                jnp.zeros((n + K,), jnp.bool_),
-                jnp.zeros((n + K,), jnp.int32)) * 2
+    ord0 = jnp.arange(n, dtype=jnp.uint32) \
+        | jnp.where(inbag, _IB_BIT, jnp.uint32(0))
     state = _CompactState(
         tree=tree, best=best, hists=hists,
-        bins_ord=jnp.pad(bins_rm, ((0, K), (0, 0))),
-        pay_ord=jnp.pad(pay0, ((0, K), (0, 0))),
-        ib_ord=jnp.pad(inbag, (0, K)),
-        order=jnp.pad(jnp.arange(n, dtype=jnp.int32), (0, K)),
-        scratch=scratch0,
+        bins2=jnp.pad(bins_rm, ((K, K + SEG), (0, 0))),
+        pay2=jnp.pad(pay0, ((K, K + SEG), (0, 0))),
+        ord2=jnp.pad(ord0, (K, K + SEG)),
+        leaf_buf=jnp.zeros((L,), jnp.int32),
         leaf_begin=jnp.zeros((L,), jnp.int32),
         leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(n),
         branch=jnp.zeros((L, F), jnp.bool_),
@@ -938,26 +1110,31 @@ def _grow_compact_impl(cfg: GrowConfig,
 
     def do_split(state: _CompactState,
                  leaf_override=None) -> _CompactState:
-        (tree, best, hists, bins_ord, pay_ord, ib_ord, order, _scr,
+        (tree, best, hists, bins2, pay2, ord2, leaf_buf,
          lbegin, lcount, branch, ns, cegb_st, mono_st, nmask_st) = state
         leaf = jnp.argmax(best.gain).astype(jnp.int32) \
             if leaf_override is None else leaf_override
         R = ns + 1
         start = lbegin[leaf]
         cnt = lcount[leaf]
+        src = leaf_buf[leaf]
         f_split = best.feature[leaf]
         t_bin = best.threshold_bin[leaf]
         dl = best.default_left[leaf]
         isc = best.is_cat[leaf]
         cm = best.cat_mask[leaf]
+        est_left_small = best.left_count[leaf] <= best.right_count[leaf]
         lazy_arr = cegb_st[1] if cegb else jnp.zeros((1, 1), jnp.bool_)
 
         # -- partition the leaf's range (DataPartition::Split analog) +
-        # smaller-child histogram, fused into the same streaming pass --
-        (bins_ord, pay_ord, ib_ord, order, lazy_arr, scratch, n_left,
-         nl_ex, nr_ex, left_smaller, small_hist, small_nu) = part_apply(
-            bins_ord, pay_ord, ib_ord, order, lazy_arr, state.scratch,
-            start, cnt, f_split, t_bin, dl, isc, cm)
+        # child histogram, fused into one streaming pass --
+        (bins2, pay2, ord2, lazy_arr, n_left, nl_ex, nr_ex, est_hist,
+         est_nu) = part_apply(bins2, pay2, ord2, lazy_arr, src, start,
+                              cnt, f_split, t_bin, dl, isc, cm,
+                              est_left_small)
+        # left child stays in the parent's half; right child was packed
+        # into the opposite half
+        leaf_buf = leaf_buf.at[R].set(1 - src)
         lbegin = lbegin.at[R].set(start + n_left)
         lcount = lcount.at[leaf].set(n_left).at[R].set(cnt - n_left)
 
@@ -966,9 +1143,9 @@ def _grow_compact_impl(cfg: GrowConfig,
                                     nl_ex, nr_ex)
 
         parent_hist = hists[leaf]
-        big_hist = subtract_histogram(parent_hist, small_hist)
-        left_hist = jnp.where(left_smaller, small_hist, big_hist)
-        right_hist = jnp.where(left_smaller, big_hist, small_hist)
+        other_hist = subtract_histogram(parent_hist, est_hist)
+        left_hist = jnp.where(est_left_small, est_hist, other_hist)
+        right_hist = jnp.where(est_left_small, other_hist, est_hist)
         hists = hists.at[leaf].set(left_hist).at[R].set(right_hist)
 
         # -- monotone output-bound entries (BasicLeafConstraints::Update /
@@ -1023,10 +1200,12 @@ def _grow_compact_impl(cfg: GrowConfig,
             coupled_used = coupled_used | (jnp.arange(F) == f_split)
             # parent rows acquired f_split during partition; counts for
             # the children follow by subtraction on the updated parent
+            # (the pass counted f pre-acquisition, so zero it here too)
+            est_nu_z = est_nu.at[f_split].set(0.0)
             parent_nu = lazy_nu[leaf].at[f_split].set(0.0)
-            big_nu = jnp.maximum(parent_nu - small_nu, 0.0)
-            left_nu = jnp.where(left_smaller, small_nu, big_nu)
-            right_nu = jnp.where(left_smaller, big_nu, small_nu)
+            big_nu = jnp.maximum(parent_nu - est_nu_z, 0.0)
+            left_nu = jnp.where(est_left_small, est_nu_z, big_nu)
+            right_nu = jnp.where(est_left_small, big_nu, est_nu_z)
             lazy_nu = lazy_nu.at[leaf].set(left_nu).at[R].set(right_nu)
             cegb_st = (coupled_used, lazy_arr, lazy_nu)
             pen_l = cegb_penalty(nl_ex, coupled_used, left_nu)
@@ -1096,8 +1275,8 @@ def _grow_compact_impl(cfg: GrowConfig,
                 lambda b: b, best)
 
         return _CompactState(tree=tree, best=best, hists=hists,
-                             bins_ord=bins_ord, pay_ord=pay_ord,
-                             ib_ord=ib_ord, order=order, scratch=scratch,
+                             bins2=bins2, pay2=pay2, ord2=ord2,
+                             leaf_buf=leaf_buf,
                              leaf_begin=lbegin, leaf_count=lcount,
                              branch=branch, num_splits=ns + 1,
                              cegb=cegb_st, mono=mono_st,
@@ -1176,8 +1355,15 @@ def _grow_compact_impl(cfg: GrowConfig,
             & (jnp.max(state.best.gain) > 0.0)
 
     state = lax.while_loop(can_grow, do_split, state)
-    row_leaf = _row_leaf_from_order(state.order[:n], state.leaf_begin,
-                                    state.leaf_count, n, L)
+    # merge the per-leaf windows (each living in one ping-pong half)
+    # into one coherent order vector, then invert
+    leaf_of_pos = _leaf_of_positions(state.leaf_begin, state.leaf_count,
+                                     n, L)
+    in_b1 = state.leaf_buf[leaf_of_pos] == 1
+    order_m = jnp.where(in_b1, state.ord2[SEG + K: SEG + K + n],
+                        state.ord2[K: K + n])
+    order_ids = (order_m & ~_IB_BIT).astype(jnp.int32)
+    row_leaf = _row_leaf_from_order(order_ids, leaf_of_pos)
     tree = state.tree
     if quant and cfg.renew_leaf:
         # RenewIntGradTreeOutput (gradient_discretizer.hpp): replace the

@@ -236,8 +236,8 @@ def find_best_split(hist: jnp.ndarray,
                     gain_penalty: jnp.ndarray | None = None,
                     parent_output: jnp.ndarray | None = None,
                     leaf_depth: jnp.ndarray | None = None,
-                    bounds: tuple | None = None
-                    ) -> SplitResult:
+                    bounds: tuple | None = None,
+                    return_feature_gains: bool = False):
     """Find the best (feature, threshold) over a leaf's histograms.
 
     Args:
@@ -436,7 +436,7 @@ def find_best_split(hist: jnp.ndarray,
         ro = jnp.where(is_sorted_cat, leaf_output(rg, rh, p_cat),
                        leaf_output(rg, rh, p))
 
-    return SplitResult(
+    result = SplitResult(
         gain=gain.astype(dtype),
         feature=f.astype(jnp.int32),
         threshold_bin=t.astype(jnp.int32),
@@ -448,3 +448,84 @@ def find_best_split(hist: jnp.ndarray,
         left_output=lo,
         right_output=ro,
     )
+    if return_feature_gains:
+        # best net gain per feature — the voting-parallel learner's
+        # local ballot (VotingParallelTreeLearner top-k proposals)
+        return result, jnp.max(all_gains, axis=(0, 2))
+    return result
+
+
+def find_best_split_bundled(hist: jnp.ndarray,
+                            parent_g: jnp.ndarray,
+                            parent_h: jnp.ndarray,
+                            parent_cnt: jnp.ndarray,
+                            member_at: jnp.ndarray,
+                            tloc_at: jnp.ndarray,
+                            end_at: jnp.ndarray,
+                            is_direct_f: jnp.ndarray,
+                            feature_mask: jnp.ndarray,
+                            p: SplitParams) -> SplitResult:
+    """Best split over an EFB-bundled histogram (ops/bundling.py layout).
+
+    Every candidate is one (bundle, position) cell:
+    - direct (singleton) bundles behave exactly like the plain scan:
+      ``left = cum[position]`` with threshold = position;
+    - multi-member bundles host member thresholds at their mapped
+      positions, with ``left = leaf_total - (range_end_cum - cum)`` —
+      the member's bin-0 mass reconstructed from the leaf totals (the
+      FixHistogram / most_freq_bin trick, dataset.h:760).
+
+    Bundled mode is restricted to plain numerical features (no NaN
+    bins, no categoricals — Dataset eligibility guarantees it), so the
+    dual missing-direction scan collapses to the single
+    missing-goes-right direction.
+    """
+    G, B, _ = hist.shape
+    dtype = hist.dtype
+    cnt_factor = parent_cnt / jnp.maximum(parent_h, K_EPS)
+    h3 = jnp.concatenate([hist, jnp.round(hist[..., 1:2] * cnt_factor)],
+                         axis=-1)
+    total = jnp.stack([parent_g, parent_h, parent_cnt]).astype(dtype)
+
+    cum = jnp.cumsum(h3, axis=1)                       # [G, B, 3]
+    cum_flat = cum.reshape(G * B, 3)
+    e = cum_flat[jnp.clip(end_at, 0, G * B - 1).reshape(-1)] \
+        .reshape(G, B, 3)
+    has_member = member_at >= 0
+    member_ix = jnp.maximum(member_at, 0)
+    direct_pos = is_direct_f[member_ix] & has_member
+    left = jnp.where(direct_pos[:, :, None], cum,
+                     total[None, None, :] - (e - cum))
+    right = total[None, None, :] - left
+    lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
+    rg, rh, rc = right[..., 0], right[..., 1], right[..., 2]
+    valid = (
+        has_member & feature_mask[member_ix]
+        & (lc >= p.min_data_in_leaf) & (rc >= p.min_data_in_leaf)
+        & (lh >= p.min_sum_hessian_in_leaf)
+        & (rh >= p.min_sum_hessian_in_leaf)
+        & (lc > 0) & (rc > 0)
+    )
+    gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p)
+    parent_gain = leaf_gain(total[0], total[1], p)
+    net = jnp.where(valid, gain - parent_gain - p.min_gain_to_split,
+                    K_MIN_SCORE)
+
+    flat = jnp.argmax(net)
+    g = flat // B
+    pos = flat % B
+    best = net.reshape(-1)[flat]
+    lgs, lhs, lcs = lg[g, pos], lh[g, pos], lc[g, pos]
+    rgs, rhs, rcs = rg[g, pos], rh[g, pos], rc[g, pos]
+    return SplitResult(
+        gain=jnp.where(jnp.isfinite(best), best, K_MIN_SCORE)
+        .astype(dtype),
+        feature=member_at[g, pos].astype(jnp.int32),
+        threshold_bin=tloc_at[g, pos].astype(jnp.int32),
+        default_left=jnp.asarray(False),
+        is_cat=jnp.asarray(False),
+        cat_mask=jnp.zeros((B,), jnp.bool_),
+        left_sum_g=lgs, left_sum_h=lhs, left_count=lcs,
+        right_sum_g=rgs, right_sum_h=rhs, right_count=rcs,
+        left_output=leaf_output(lgs, lhs, p),
+        right_output=leaf_output(rgs, rhs, p))

@@ -16,11 +16,13 @@ SyncUpGlobalBestSplit (allreduce max-gain) ->  not needed: every device
                                                identical argmax
 global leaf counts allreduce               ->  psum of root/leaf sums
 
-Feature-parallel and voting-parallel learners exist in the reference to
-cut network traffic on slow interconnects (feature_parallel_…cpp,
-voting_parallel_…cpp). On ICI bandwidth the histogram psum is cheap, so
-``tree_learner=feature|voting`` map to this same mesh path (a dedicated
-feature-sharded learner is planned for DCN-spanning pods).
+``tree_learner=feature`` and ``=voting`` build the same shard_map with
+the grower's ``parallel_mode`` switched (GrowConfig.parallel_mode):
+feature-parallel replicates rows (every in_spec P()) and allreduces the
+best SplitInfo across disjoint per-device feature shards
+(feature_parallel_tree_learner.cpp:71); voting shards rows but keeps
+the histogram cache local, reducing only vote-elected features per
+search (voting_parallel_tree_learner.cpp:364).
 """
 
 from __future__ import annotations
@@ -50,16 +52,23 @@ def _build(cfg: GrowConfig, mesh: Mesh, has_monotone: bool, has_cat: bool,
            has_forced: bool = False, has_node_key: bool = False):
     axis = mesh.axis_names[0]
     cfg = cfg._replace(axis_name=axis)
-    rowspec = P(axis)
+    if cfg.parallel_mode == "feature":
+        # rows replicated: every device holds the full dataset and owns
+        # a feature shard inside the grower's split search
+        rowspec = P()
+    else:
+        rowspec = P(axis)
     rep = P()
 
-    in_specs = (P(None, axis), rowspec, rowspec, rowspec, rep, rep, rep)
+    in_specs = (P(None, axis) if cfg.parallel_mode != "feature"
+                else P(None, None),
+                rowspec, rowspec, rowspec, rep, rep, rep)
     in_specs = in_specs + (rep,) * (int(has_monotone) + int(has_cat)
                                     + int(has_quant_key)
                                     + int(has_interaction)
                                     + 3 * int(has_forced)
                                     + int(has_node_key))
-    out_specs = (rep, rowspec)  # tree replicated, row_leaf sharded
+    out_specs = (rep, rowspec)  # tree replicated, row_leaf row-layout
 
     def fn(bins_T, grad, hess, row_w, fmask, fnb, fnan, *rest):
         rest = list(rest)

@@ -1,0 +1,115 @@
+"""Multi-host (multi-controller) initialization.
+
+The reference reaches multi-machine training through ``Network::Init``
+(/root/reference/src/network/linkers_socket.cpp:169 TCP mesh handshake /
+linkers_mpi.cpp:16 MPI world) configured by ``machines``/``mlist`` +
+``local_listen_port`` + ``num_machines``
+(/root/reference/src/application/application.cpp:168-176; the Dask layer
+assembles the same params, python-package/lightgbm/dask.py:495-520).
+
+The TPU-native replacement is JAX's multi-controller runtime: every host
+runs the same program, ``jax.distributed.initialize`` wires the
+processes, and ``jax.devices()`` then spans all hosts so the ordinary
+data-parallel Mesh (parallel/mesh.py) covers the pod — ICI inside a
+slice, DCN across slices — with no linker layer at all.
+
+``init_distributed`` accepts BOTH the native JAX arguments and the
+reference's machine-list vocabulary so a LightGBM-style launch config
+ports directly:
+
+    # reference-style (mlist.txt holds "host:port" lines, rank inferred)
+    init_distributed(machine_list_file="mlist.txt", local_rank=0)
+    # or explicit
+    init_distributed(machines="10.0.0.1:12400,10.0.0.2:12400",
+                     local_rank=1)
+    # or native
+    init_distributed(coordinator_address="10.0.0.1:12400",
+                     num_processes=2, process_id=1)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+__all__ = ["init_distributed", "shutdown_distributed", "parse_machines"]
+
+_INITIALIZED = False
+
+
+def parse_machines(machines: Optional[str] = None,
+                   machine_list_file: Optional[str] = None
+                   ) -> List[Tuple[str, int]]:
+    """Parse the reference's machine-list formats: a comma/newline
+    separated ``host:port`` string (config ``machines``) or a file with
+    one ``host port`` / ``host:port`` per line (``machine_list_file``,
+    tests/distributed/_test_distributed.py:23-38)."""
+    entries: List[str] = []
+    if machines:
+        entries = [m for m in machines.replace("\n", ",").split(",") if m]
+    elif machine_list_file:
+        with open(machine_list_file) as fh:
+            entries = [ln.strip() for ln in fh if ln.strip()]
+    out = []
+    for e in entries:
+        host, _, port = e.replace(" ", ":").partition(":")
+        out.append((host, int(port or 0)))
+    return out
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     machines: Optional[str] = None,
+                     machine_list_file: Optional[str] = None,
+                     local_rank: Optional[int] = None) -> None:
+    """Wire this process into a multi-host JAX runtime (the
+    ``LGBM_NetworkInit`` / ``Network::Init`` analog).
+
+    With reference-style arguments, the first machine in the list is
+    the coordinator and ``local_rank`` (or env ``LIGHTGBM_TPU_RANK``)
+    selects this process's slot. A single-entry machine list is a
+    no-op, matching ``num_machines=1``. Under standard TPU pod
+    launchers (GKE/queued resources) the arguments can all be omitted —
+    ``jax.distributed.initialize()`` discovers the topology itself.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    import jax
+
+    if coordinator_address is None and (machines or machine_list_file):
+        mlist = parse_machines(machines, machine_list_file)
+        if len(mlist) <= 1:
+            return  # num_machines=1: nothing to wire
+        host, port = mlist[0]
+        coordinator_address = f"{host}:{port}"
+        num_processes = len(mlist)
+        if process_id is None:
+            rank = local_rank if local_rank is not None else int(
+                os.environ.get("LIGHTGBM_TPU_RANK", "-1"))
+            if rank < 0:
+                raise ValueError(
+                    "machine-list initialization needs local_rank (or "
+                    "env LIGHTGBM_TPU_RANK) to identify this process")
+            process_id = rank
+
+    if coordinator_address is None and num_processes is None:
+        jax.distributed.initialize()
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id)
+    _INITIALIZED = True
+
+
+def shutdown_distributed() -> None:
+    """Tear the multi-controller runtime down (MPI_Finalize analog)."""
+    global _INITIALIZED
+    if not _INITIALIZED:
+        return
+    import jax
+
+    jax.distributed.shutdown()
+    _INITIALIZED = False

@@ -2,9 +2,17 @@
 
 Re-design of the reference's PredictContrib path
 (/root/reference/src/boosting/gbdt.cpp:640 and the TreeSHAP recursion in
-src/io/tree.cpp). Host-side recursive TreeSHAP over the numpy tree arrays;
-a batched device implementation is planned once the interaction surface
-stabilizes.
+src/io/tree.cpp) as a ROW-VECTORIZED walk: the classic recursion carries
+a per-row decision path, but only the binary ``one_fraction`` entries
+are row-dependent — the cover ratios (``zero_fraction``) and the path's
+feature sequence are properties of the tree node alone. So the walk
+visits each tree node once, carrying the path state as ``[n, depth]``
+numpy arrays and doing the extend/unwind algebra on whole row batches,
+instead of recursing per row. Same math, O(num_nodes · depth) vector
+steps instead of O(n · num_nodes · depth) Python steps.
+
+``_tree_shap_row`` keeps the textbook single-row recursion as the
+cross-check oracle for tests.
 """
 
 from __future__ import annotations
@@ -15,6 +23,158 @@ import numpy as np
 
 __all__ = ["predict_contrib"]
 
+
+# ---------------------------------------------------------------------------
+# Vectorized TreeSHAP: one node visit, all rows at once
+# ---------------------------------------------------------------------------
+
+class _VecPath:
+    """Decision-path state for a batch of rows at one recursion depth.
+
+    feature_index / zero_fraction are per-element scalars (shared by all
+    rows); one_fraction / pweight are [n, depth+1] row-wise."""
+
+    __slots__ = ("feat", "zero", "one", "pw")
+
+    def __init__(self, n: int, cap: int):
+        self.feat = np.full(cap, -1, np.int64)
+        self.zero = np.zeros(cap, np.float64)
+        self.one = np.zeros((n, cap), np.float64)
+        self.pw = np.zeros((n, cap), np.float64)
+
+    def clone(self) -> "_VecPath":
+        out = _VecPath.__new__(_VecPath)
+        out.feat = self.feat.copy()
+        out.zero = self.zero.copy()
+        out.one = self.one.copy()
+        out.pw = self.pw.copy()
+        return out
+
+
+def _vec_extend(path: _VecPath, d: int, zero: float, one: np.ndarray,
+                feat: int) -> None:
+    path.feat[d] = feat
+    path.zero[d] = zero
+    path.one[:, d] = one
+    path.pw[:, d] = 1.0 if d == 0 else 0.0
+    for i in range(d - 1, -1, -1):
+        path.pw[:, i + 1] += one * path.pw[:, i] * (i + 1) / (d + 1)
+        path.pw[:, i] *= zero * (d - i) / (d + 1)
+
+
+def _vec_unwind(path: _VecPath, d: int, idx: int) -> None:
+    one = path.one[:, idx]
+    zero = path.zero[idx]
+    nz = one != 0
+    next_one = path.pw[:, d].copy()
+    for i in range(d - 1, -1, -1):
+        tmp = path.pw[:, i].copy()
+        with np.errstate(divide="ignore", invalid="ignore"):
+            pw_nz = next_one * (d + 1) / ((i + 1) * one)
+        pw_z = tmp * (d + 1) / (zero * (d - i)) if zero * (d - i) != 0 \
+            else np.zeros_like(tmp)
+        path.pw[:, i] = np.where(nz, pw_nz, pw_z)
+        next_one = np.where(nz,
+                            tmp - path.pw[:, i] * zero * (d - i) / (d + 1),
+                            next_one)
+    path.feat[idx:d] = path.feat[idx + 1:d + 1]
+    path.zero[idx:d] = path.zero[idx + 1:d + 1]
+    path.one[:, idx:d] = path.one[:, idx + 1:d + 1]
+
+
+def _vec_unwound_sum(path: _VecPath, d: int, idx: int) -> np.ndarray:
+    one = path.one[:, idx]
+    zero = path.zero[idx]
+    nz = one != 0
+    total = np.zeros(path.one.shape[0], np.float64)
+    next_one = path.pw[:, d].copy()
+    for i in range(d - 1, -1, -1):
+        with np.errstate(divide="ignore", invalid="ignore"):
+            tmp = np.where(nz, next_one * (d + 1) / ((i + 1) * one), 0.0)
+        total += tmp
+        next_one = np.where(nz,
+                            path.pw[:, i] - tmp * zero * (d - i) / (d + 1),
+                            next_one)
+        if zero * (d - i) != 0:
+            total += np.where(nz, 0.0,
+                              path.pw[:, i]
+                              / (zero * (d - i) / (d + 1)))
+    return total
+
+
+def _vec_tree_shap(tree, X: np.ndarray, phi: np.ndarray, node: int,
+                   d: int, parent: _VecPath, pzero: float,
+                   pone: np.ndarray, pfeat: int) -> None:
+    """Visit ``node`` carrying all rows at once; rows whose
+    one_fraction chain has hit zero contribute nothing downstream but
+    stay in the batch for shape stability."""
+    path = parent.clone()
+    _vec_extend(path, d, pzero, pone, pfeat)
+
+    if node < 0:  # leaf
+        leaf_v = float(tree.leaf_value[~node])
+        for i in range(1, d + 1):
+            w = _vec_unwound_sum(path, d, i)
+            phi[:, path.feat[i]] += w * (path.one[:, i] - path.zero[i]) \
+                * leaf_v
+        return
+
+    f = int(tree.split_feature[node])
+    l, r = int(tree.left_child[node]), int(tree.right_child[node])
+    go_left = _decide_left_rows(tree, node, X[:, f])
+    w_node = float(tree.internal_count[node])
+    lz = _child_count(tree, l) / w_node if w_node > 0 else 0.0
+    rz = _child_count(tree, r) / w_node if w_node > 0 else 0.0
+
+    inc_zero = 1.0
+    inc_one = np.ones(X.shape[0], np.float64)
+    path_index = 0
+    while path_index <= d:
+        if path.feat[path_index] == f:
+            break
+        path_index += 1
+    if path_index != d + 1:
+        inc_zero = float(path.zero[path_index])
+        inc_one = path.one[:, path_index].copy()
+        _vec_unwind(path, d, path_index)
+        d -= 1
+
+    _vec_tree_shap(tree, X, phi, l, d + 1, path, lz * inc_zero,
+                   inc_one * go_left, f)
+    _vec_tree_shap(tree, X, phi, r, d + 1, path, rz * inc_zero,
+                   inc_one * (1.0 - go_left), f)
+
+
+def _decide_left_rows(tree, node: int, v: np.ndarray) -> np.ndarray:
+    """Vectorized Tree::Decision over a column of raw values
+    (NumericalDecision missing routing tree.h:338-356, categorical
+    bitset probe tree.h:402-410)."""
+    if tree.is_categorical_node(node):
+        iv = np.where(np.isnan(v) | (v < 0), -1, v).astype(np.int64)
+        cat_idx = int(tree.threshold[node])
+        lo = int(tree.cat_boundaries[cat_idx])
+        hi = int(tree.cat_boundaries[cat_idx + 1])
+        word = iv >> 5
+        ok = (iv >= 0) & (word < hi - lo)
+        wsel = np.where(ok, lo + word, lo).astype(np.int64)
+        bits = tree.cat_threshold[wsel].astype(np.int64)
+        hit = ((bits >> (iv & 31)) & 1) != 0
+        return (ok & hit).astype(np.float64)
+    mt = tree.missing_type(node)
+    dl = bool(tree.default_left(node))
+    isnan = np.isnan(v)
+    vv = np.where(isnan & (mt != 2), 0.0, v)
+    out = vv <= tree.threshold[node]
+    if mt == 2:
+        out = np.where(isnan, dl, out)
+    elif mt == 1:
+        out = np.where(np.abs(vv) <= 1e-35, dl, out)
+    return out.astype(np.float64)
+
+
+# ---------------------------------------------------------------------------
+# Reference single-row recursion (oracle for tests)
+# ---------------------------------------------------------------------------
 
 class _PathElement:
     __slots__ = ("feature_index", "zero_fraction", "one_fraction",
@@ -82,10 +242,10 @@ def _unwound_path_sum(path: List[_PathElement], unique_depth: int,
     return total
 
 
-def _tree_shap(tree, x: np.ndarray, phi: np.ndarray, node: int,
-               unique_depth: int, parent_path: List[_PathElement],
-               parent_zero_fraction: float, parent_one_fraction: float,
-               parent_feature_index: int) -> None:
+def _tree_shap_row(tree, x: np.ndarray, phi: np.ndarray, node: int,
+                   unique_depth: int, parent_path: List[_PathElement],
+                   parent_zero_fraction: float, parent_one_fraction: float,
+                   parent_feature_index: int) -> None:
     path = [
         _PathElement(p.feature_index, p.zero_fraction, p.one_fraction,
                      p.pweight)
@@ -113,7 +273,6 @@ def _tree_shap(tree, x: np.ndarray, phi: np.ndarray, node: int,
     cold_zero_fraction = cold_count / w_node if w_node > 0 else 0.0
     incoming_zero_fraction = 1.0
     incoming_one_fraction = 1.0
-    # undo re-used feature occurrences further up the path
     path_index = 0
     while path_index <= unique_depth:
         if path[path_index].feature_index == f:
@@ -125,11 +284,11 @@ def _tree_shap(tree, x: np.ndarray, phi: np.ndarray, node: int,
         _unwind_path(path, unique_depth, path_index)
         unique_depth -= 1
 
-    _tree_shap(tree, x, phi, hot, unique_depth + 1, path,
-               hot_zero_fraction * incoming_zero_fraction,
-               incoming_one_fraction, f)
-    _tree_shap(tree, x, phi, cold, unique_depth + 1, path,
-               cold_zero_fraction * incoming_zero_fraction, 0.0, f)
+    _tree_shap_row(tree, x, phi, hot, unique_depth + 1, path,
+                   hot_zero_fraction * incoming_zero_fraction,
+                   incoming_one_fraction, f)
+    _tree_shap_row(tree, x, phi, cold, unique_depth + 1, path,
+                   cold_zero_fraction * incoming_zero_fraction, 0.0, f)
 
 
 def _child_count(tree, node: int) -> float:
@@ -157,7 +316,21 @@ def _expected_value(tree) -> float:
                         * tree.leaf_count[: tree.num_leaves]) / total)
 
 
-def predict_contrib(booster, X: np.ndarray, trees, K: int) -> np.ndarray:
+def _max_depth(tree) -> int:
+    depth = np.zeros(max(tree.num_nodes, 1), np.int64)
+    best = 1
+    for i in range(tree.num_nodes):
+        for c in (int(tree.left_child[i]), int(tree.right_child[i])):
+            if c >= 0:
+                depth[c] = depth[i] + 1
+                best = max(best, int(depth[c]) + 1)
+            else:
+                best = max(best, int(depth[i]) + 2)
+    return best
+
+
+def predict_contrib(booster, X: np.ndarray, trees, K: int,
+                    row_chunk: int = 65536) -> np.ndarray:
     """Per-feature SHAP values + expected-value column, shape
     [n, (F+1)*K] matching LGBM_BoosterPredictForMat contrib layout."""
     n, _ = X.shape
@@ -170,9 +343,18 @@ def predict_contrib(booster, X: np.ndarray, trees, K: int) -> np.ndarray:
             out[:, base + F] += float(tree.leaf_value[0])
             continue
         ev = _expected_value(tree)
-        for r in range(n):
-            phi = np.zeros(F + 1, np.float64)
-            _tree_shap(tree, X[r], phi, 0, 0, [], 1.0, 1.0, -1)
-            phi[F] += ev
-            out[r, base: base + F + 1] += phi
+        cap = _max_depth(tree) + 2
+        # up to `cap` recursion frames each clone [chunk, cap] f64
+        # path state; scale the chunk down for deep trees so peak
+        # memory stays bounded (~cap^2 * chunk * 16B)
+        chunk = min(row_chunk, max(256, 8_000_000 // (cap * cap)))
+        for r0 in range(0, n, chunk):
+            Xc = X[r0: r0 + chunk]
+            nc = Xc.shape[0]
+            phi = np.zeros((nc, F + 1), np.float64)
+            root = _VecPath(nc, cap)
+            _vec_tree_shap(tree, Xc, phi, 0, 0, root, 1.0,
+                           np.ones(nc, np.float64), -1)
+            phi[:, F] += ev
+            out[r0: r0 + nc, base: base + F + 1] += phi
     return out

@@ -1,0 +1,112 @@
+"""Exclusive Feature Bundling (FeatureGroup / EFB, feature_group.h:26):
+zero-conflict bundles must reproduce the unbundled model exactly, and a
+wide sparse matrix must collapse to few bundle columns."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.bundling import build_bundles
+
+
+def _sparse_onehot(n, groups, per_group, seed=0, noise_feats=2):
+    """One-hot blocks (mutually exclusive by construction) + a couple
+    of dense features."""
+    rs = np.random.RandomState(seed)
+    cols = []
+    signal = np.zeros(n)
+    for g in range(groups):
+        pick = rs.randint(0, per_group, n)
+        block = np.zeros((n, per_group))
+        vals = rs.rand(per_group) * 2
+        block[np.arange(n), pick] = vals[pick]
+        cols.append(block)
+        signal += vals[pick]
+    dense = rs.randn(n, noise_feats)
+    X = np.hstack(cols + [dense])
+    y = (signal + 0.5 * dense[:, 0]
+         + 0.3 * rs.randn(n) > np.median(signal)).astype(float)
+    return X, y
+
+
+def test_build_bundles_collapses_onehot_blocks():
+    X, y = _sparse_onehot(4000, groups=6, per_group=8)
+    d = lgb.Dataset(X, label=y)
+    d.construct()
+    info = build_bundles(d.host_bins(), d.mappers)
+    assert info is not None
+    F = d.num_features()
+    G = info.bins_bundled.shape[1]
+    assert G < F / 2
+    # round-trip: every row/feature bin must be recoverable from its
+    # bundle column
+    bins = d.host_bins()
+    for j in rs_choice(F, 12):
+        g = info.bundle_of[j]
+        col = info.bins_bundled[:, g].astype(np.int64)
+        if info.is_direct[j]:
+            rec = col
+        else:
+            off, nb = int(info.offset_of[j]), d.mappers[j].num_bins
+            inside = (col >= off) & (col <= off + nb - 2)
+            rec = np.where(inside, col - off + 1, 0)
+        np.testing.assert_array_equal(rec, bins[:, j])
+
+
+def rs_choice(F, k):
+    rs = np.random.RandomState(1)
+    return rs.choice(F, size=min(k, F), replace=False)
+
+
+def test_bundled_training_matches_unbundled_exactly():
+    X, y = _sparse_onehot(3000, groups=4, per_group=6, seed=3)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=6)
+    assert bundled._engine.bundle is not None, "bundling did not engage"
+    assert len(plain._models) == len(bundled._models)
+    for ta, tb in zip(plain._models, bundled._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        np.testing.assert_array_equal(ta.split_feature[:nn],
+                                      tb.split_feature[:nn])
+        np.testing.assert_array_equal(ta.threshold_bin[:nn],
+                                      tb.threshold_bin[:nn])
+        # leaf values agree up to the f32 rounding of the bin-0
+        # reconstruction (total - range); structure is bit-identical
+        np.testing.assert_allclose(ta.leaf_value[:ta.num_leaves],
+                                   tb.leaf_value[:tb.num_leaves],
+                                   rtol=5e-3, atol=1e-5)
+    np.testing.assert_allclose(plain.predict(X[:200]),
+                               bundled.predict(X[:200]),
+                               rtol=5e-3, atol=1e-4)
+
+
+def test_wide_sparse_matrix_trains_with_small_cache():
+    """The VERDICT target: a multi-thousand-feature sparse synthetic
+    must train with the histogram cache scaled by bundles, not
+    features."""
+    X, y = _sparse_onehot(3000, groups=40, per_group=25, seed=5)
+    assert X.shape[1] == 40 * 25 + 2
+    d = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "verbosity": -1, "min_data_in_leaf": 5}, d,
+                    num_boost_round=4)
+    info = bst._engine.bundle
+    assert info is not None
+    assert info.bins_bundled.shape[1] < 120
+    p = bst.predict(X[:500])
+    assert np.all(np.isfinite(p))
+    assert np.mean((p > 0.5) == (y[:500] > 0.5)) > 0.7
+
+
+def test_bundling_skipped_with_dense_data():
+    rs = np.random.RandomState(2)
+    X = rs.randn(1500, 8)
+    y = (X[:, 0] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3)
+    assert bst._engine.bundle is None

@@ -138,3 +138,19 @@ def test_dp_forced_splits_identical_trees(tmp_path):
     _trees_equal(dp, sp)
     for t in dp._models:
         assert int(t.split_feature[0]) == 1
+
+
+def test_parse_machines_formats(tmp_path):
+    from lightgbm_tpu.parallel.distributed import parse_machines
+    assert parse_machines("10.0.0.1:12400,10.0.0.2:12401") == [
+        ("10.0.0.1", 12400), ("10.0.0.2", 12401)]
+    mfile = tmp_path / "mlist.txt"
+    mfile.write_text("hostA 500\nhostB:600\n")
+    assert parse_machines(machine_list_file=str(mfile)) == [
+        ("hostA", 500), ("hostB", 600)]
+
+
+def test_init_distributed_single_machine_noop():
+    # num_machines=1 machine lists must not try to wire a cluster
+    from lightgbm_tpu.parallel.distributed import init_distributed
+    init_distributed(machines="localhost:12400")  # single entry: no-op

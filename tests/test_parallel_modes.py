@@ -1,0 +1,98 @@
+"""Feature-parallel and voting-parallel tree learners on the 8-device
+virtual CPU mesh (FeatureParallelTreeLearner /
+VotingParallelTreeLearner; the reference's _test_distributed.py
+equivalence pattern)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import lightgbm_tpu as lgb
+from conftest import make_synthetic_binary
+
+needs_mesh = pytest.mark.skipif(len(jax.devices()) < 2,
+                                reason="needs a multi-device mesh")
+
+
+def _train(tree_learner, X, y, extra=None, rounds=6):
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "tree_learner": tree_learner,
+              "metric": "binary_logloss"}
+    params.update(extra or {})
+    return lgb.train(params, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds)
+
+
+def _trees_equal(a, b):
+    if len(a._models) != len(b._models):
+        return False
+    for ta, tb in zip(a._models, b._models):
+        if ta.num_leaves != tb.num_leaves:
+            return False
+        nn = ta.num_nodes
+        for fld in ("split_feature", "threshold_bin", "left_child",
+                    "right_child"):
+            if not np.array_equal(getattr(ta, fld)[:nn],
+                                  getattr(tb, fld)[:nn]):
+                return False
+        if not np.allclose(ta.leaf_value[:ta.num_leaves],
+                           tb.leaf_value[:tb.num_leaves],
+                           rtol=1e-5, atol=1e-7):
+            return False
+    return True
+
+
+@needs_mesh
+def test_feature_parallel_matches_serial():
+    X, y = make_synthetic_binary(n=4000, f=11, seed=7)
+    serial = _train("serial", X, y)
+    feat = _train("feature", X, y)
+    assert _trees_equal(serial, feat)
+    np.testing.assert_allclose(serial.predict(X[:100]),
+                               feat.predict(X[:100]),
+                               rtol=1e-5, atol=1e-7)
+
+
+@needs_mesh
+def test_voting_parallel_full_vote_matches_data_parallel():
+    X, y = make_synthetic_binary(n=4000, f=9, seed=3)
+    # 2*top_k >= F elects every feature -> identical to data-parallel
+    data = _train("data", X, y)
+    voting = _train("voting", X, y, extra={"top_k": 9})
+    assert _trees_equal(data, voting)
+
+
+@needs_mesh
+def test_voting_parallel_restricted_vote_trains():
+    rs = np.random.RandomState(11)
+    X = rs.randn(4000, 16)
+    y = ((X[:, 0] + 0.5 * X[:, 3] + 0.25 * X[:, 9]) > 0).astype(float)
+    voting = _train("voting", X, y, extra={"top_k": 3}, rounds=10)
+    p = voting.predict(X)
+    assert np.all(np.isfinite(p))
+    # restricted voting must still learn the dominant signal
+    assert np.mean((p > 0.5) == (y > 0.5)) > 0.85
+
+
+@needs_mesh
+def test_feature_parallel_with_bagging_and_categoricals():
+    rs = np.random.RandomState(5)
+    n = 3000
+    Xc = rs.randint(0, 6, size=(n, 1)).astype(float)
+    Xn = rs.randn(n, 6)
+    X = np.hstack([Xc, Xn])
+    y = ((Xc[:, 0] % 2 == 0) ^ (Xn[:, 1] > 0)).astype(float)
+    extra = {"bagging_fraction": 0.8, "bagging_freq": 1,
+             "categorical_feature": [0]}
+    serial = lgb.train({"objective": "binary", "num_leaves": 15,
+                        "verbosity": -1, "min_data_in_leaf": 5,
+                        "tree_learner": "serial", **extra},
+                       lgb.Dataset(X, label=y, categorical_feature=[0]),
+                       num_boost_round=5)
+    feat = lgb.train({"objective": "binary", "num_leaves": 15,
+                      "verbosity": -1, "min_data_in_leaf": 5,
+                      "tree_learner": "feature", **extra},
+                     lgb.Dataset(X, label=y, categorical_feature=[0]),
+                     num_boost_round=5)
+    assert _trees_equal(serial, feat)

@@ -1,0 +1,85 @@
+"""TreeSHAP: vectorized walk vs the single-row oracle, and the additive
+(sum of contribs == raw prediction) property the reference guarantees
+(PredictContrib, gbdt.cpp:640)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.shap import (_PathElement, _tree_shap_row,
+                               _expected_value, predict_contrib)
+from conftest import make_synthetic_binary
+
+
+def _oracle_contrib(booster, X, trees, K):
+    n, _ = X.shape
+    F = booster.num_feature()
+    out = np.zeros((n, (F + 1) * K), np.float64)
+    for ti, tree in enumerate(trees):
+        k = ti % K
+        base = k * (F + 1)
+        if tree.num_leaves <= 1:
+            out[:, base + F] += float(tree.leaf_value[0])
+            continue
+        ev = _expected_value(tree)
+        for r in range(n):
+            phi = np.zeros(F + 1, np.float64)
+            _tree_shap_row(tree, X[r], phi, 0, 0, [], 1.0, 1.0, -1)
+            phi[F] += ev
+            out[r, base: base + F + 1] += phi
+    return out
+
+
+def _fit(params, X, y, rounds=6):
+    return lgb.train({"objective": "binary", "num_leaves": 12,
+                      "min_data_in_leaf": 5, "verbosity": -1, **params},
+                     lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+def test_vectorized_matches_oracle():
+    X, y = make_synthetic_binary(n=800, f=6, seed=31)
+    bst = _fit({}, X, y)
+    probe = X[:40]
+    got = predict_contrib(bst, probe, bst._models, 1)
+    want = _oracle_contrib(bst, probe, bst._models, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12)
+
+
+def test_vectorized_matches_oracle_with_nan():
+    rs = np.random.RandomState(9)
+    X, y = make_synthetic_binary(n=900, f=5, seed=17)
+    X = X.copy()
+    X[rs.rand(*X.shape) < 0.15] = np.nan
+    bst = _fit({}, X, y)
+    probe = X[:30]
+    got = predict_contrib(bst, probe, bst._models, 1)
+    want = _oracle_contrib(bst, probe, bst._models, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12)
+
+
+def test_contrib_sums_to_raw_prediction():
+    X, y = make_synthetic_binary(n=1000, f=7, seed=3)
+    bst = _fit({}, X, y, rounds=10)
+    probe = X[:64]
+    contrib = bst.predict(probe, pred_contrib=True)
+    raw = bst.predict(probe, raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_contrib_categorical_matches_oracle():
+    rs = np.random.RandomState(5)
+    n = 1200
+    Xc = rs.randint(0, 8, size=(n, 1)).astype(float)
+    Xn = rs.randn(n, 3)
+    X = np.hstack([Xc, Xn])
+    y = ((Xc[:, 0] % 2 == 0) ^ (Xn[:, 0] > 0)).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 12,
+                     "verbosity": -1, "min_data_in_leaf": 5,
+                     "categorical_feature": [0]},
+                    lgb.Dataset(X, label=y, categorical_feature=[0]),
+                    num_boost_round=5)
+    probe = X[:25]
+    got = predict_contrib(bst, probe, bst._models, 1)
+    want = _oracle_contrib(bst, probe, bst._models, 1)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12)
