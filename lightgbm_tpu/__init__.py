@@ -8,7 +8,7 @@ instead of sockets/MPI; and a drop-in `Dataset`/`Booster`/`train` Python
 API mirroring the reference python-package.
 """
 
-from .basic import Booster, Dataset, LightGBMError
+from .basic import Booster, Dataset, LightGBMError, Sequence
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
                        record_evaluation, reset_parameter)
 from .config import Config

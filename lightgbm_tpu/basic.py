@@ -14,7 +14,7 @@ import io
 import os
 import warnings
 from pathlib import Path
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 
@@ -23,7 +23,7 @@ from .metrics import create_metrics
 from .objectives import create_objective
 from .ops.binning import BinMapper, BinType, MissingType, bin_values, find_bin
 
-__all__ = ["Dataset", "Booster", "LightGBMError"]
+__all__ = ["Dataset", "Booster", "LightGBMError", "Sequence"]
 
 
 class LightGBMError(Exception):
@@ -87,8 +87,13 @@ def _load_text_file(path: str, cfg: Config
                 X[r, i] = v
         y = np.asarray(labels)
     else:
-        raw = np.genfromtxt(path, delimiter=sep,
-                            skip_header=1 if header else 0)
+        # native OpenMP parser (src/io/parser.cpp analog); numpy is the
+        # no-compiler fallback
+        from .utils.native import parse_dense_text
+        raw = parse_dense_text(path, bool(header))
+        if raw is None:
+            raw = np.genfromtxt(path, delimiter=sep,
+                                skip_header=1 if header else 0)
         if raw.ndim == 1:
             raw = raw[:, None]
         y = raw[:, label_col].copy()
@@ -144,6 +149,61 @@ def _resolve_cat_indices(categorical_feature, feature_name) -> List[int]:
     return sorted(set(out))
 
 
+class Sequence:
+    """Generic chunked data source (the reference's abstract streaming
+    Sequence, python-package/lightgbm/basic.py:903): subclass with
+    ``__getitem__`` (row index or slice -> numpy rows), ``__len__``,
+    and optionally ``batch_size``. A Sequence (or list of Sequences) is
+    a valid ``Dataset(data=...)`` — rows are pulled batch by batch, so
+    the raw source never needs to be materialized at once by the
+    caller."""
+
+    batch_size = 4096
+
+    def __getitem__(self, idx):  # pragma: no cover - abstract
+        raise NotImplementedError("Sequence.__getitem__")
+
+    def __len__(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError("Sequence.__len__")
+
+
+def _materialize_sequences(seqs) -> np.ndarray:
+    """Pull all rows from chunked Sequence sources into one matrix."""
+    parts = []
+    for s in seqs:
+        n = len(s)
+        bs = max(1, int(getattr(s, "batch_size", 4096) or 4096))
+        chunks = [np.atleast_2d(np.asarray(s[i:min(i + bs, n)],
+                                           dtype=np.float64))
+                  for i in range(0, n, bs)]
+        parts.append(np.vstack(chunks))
+    return np.vstack(parts)
+
+
+def _extract_arrow(data):
+    """pyarrow Table / RecordBatch -> [n, F] float64 + column names
+    (the reference's Arrow C-data-interface ingest, arrow.h)."""
+    import pyarrow as pa
+
+    if isinstance(data, pa.RecordBatch):
+        data = pa.Table.from_batches([data])
+    if isinstance(data, (pa.ChunkedArray, pa.Array)):
+        col = data.combine_chunks() if isinstance(data, pa.ChunkedArray) \
+            else data
+        return np.asarray(col, dtype=np.float64)[:, None], []
+    if not isinstance(data, pa.Table):
+        raise LightGBMError(
+            f"Unsupported pyarrow input {type(data)}; pass a Table, "
+            "RecordBatch or Array")
+    cols = []
+    for name in data.column_names:
+        col = data.column(name)
+        np_col = col.to_numpy(zero_copy_only=False)
+        cols.append(np.asarray(np_col, dtype=np.float64))
+    X = np.column_stack(cols) if cols else np.zeros((data.num_rows, 0))
+    return X, list(data.column_names)
+
+
 class Dataset:
     """Binned training data container (Dataset + Metadata + DatasetLoader
     analog: dataset.h:48-555, dataset_loader.cpp)."""
@@ -177,6 +237,65 @@ class Dataset:
         self._F: int = 0
         self._query_boundaries: Optional[np.ndarray] = None
         self.used_indices = None
+
+    # -- streaming push ingest (LGBM_DatasetInitStreaming /
+    # PushRows[WithMetadata] / MarkFinished, c_api.h:177-323): rows and
+    # their metadata arrive in arbitrary-order batches into a
+    # preallocated host staging area; construction (binning + device
+    # upload) happens once at mark_finished ----------------------------
+    @classmethod
+    def init_streaming(cls, num_rows: int, num_features: int,
+                       **dataset_kwargs) -> "Dataset":
+        ds = cls(data=np.zeros((0, num_features)), **dataset_kwargs)
+        ds.data = np.full((num_rows, num_features), np.nan, np.float64)
+        ds._stream_label = np.zeros(num_rows, np.float64)
+        ds._stream_weight = None
+        ds._stream_filled = np.zeros(num_rows, bool)
+        ds._stream_total = num_rows
+        return ds
+
+    def push_rows(self, mat, start_row: int = None, label=None,
+                  weight=None) -> "Dataset":
+        """Append (or place, with ``start_row``) a batch of raw rows;
+        the WithMetadata variant is the optional label/weight args."""
+        if getattr(self, "_stream_filled", None) is None:
+            raise LightGBMError(
+                "push_rows requires a Dataset.init_streaming dataset")
+        mat = np.atleast_2d(np.asarray(mat, np.float64))
+        if start_row is None:
+            filled = np.flatnonzero(~self._stream_filled)
+            start_row = int(filled[0]) if len(filled) else \
+                self._stream_total
+        end = start_row + mat.shape[0]
+        if end > self._stream_total:
+            raise LightGBMError("push_rows beyond the declared num_rows")
+        self.data[start_row:end] = mat
+        self._stream_filled[start_row:end] = True
+        if label is not None:
+            self._stream_label[start_row:end] = np.asarray(label).ravel()
+        if weight is not None:
+            if self._stream_weight is None:
+                self._stream_weight = np.ones(self._stream_total,
+                                              np.float64)
+            self._stream_weight[start_row:end] = \
+                np.asarray(weight).ravel()
+        return self
+
+    def mark_finished(self) -> "Dataset":
+        """All pushes done -> bin and construct (MarkFinished)."""
+        if getattr(self, "_stream_filled", None) is None:
+            raise LightGBMError(
+                "mark_finished requires a Dataset.init_streaming dataset")
+        if not self._stream_filled.all():
+            missing = int((~self._stream_filled).sum())
+            raise LightGBMError(
+                f"streaming dataset has {missing} unpushed rows")
+        if self.label is None:
+            self.label = self._stream_label
+        if self.weight is None and self._stream_weight is not None:
+            self.weight = self._stream_weight
+        self._stream_filled = None
+        return self.construct()
 
     # -- binary serialization (save_binary, dataset.h:692 /
     # dataset_loader.cpp:417 LoadFromBinFile analog: the binned matrix +
@@ -328,6 +447,20 @@ class Dataset:
                         label = label.to_numpy().ravel()
                 except ImportError:
                     pass
+            elif type(data).__module__.split(".")[0] == "pyarrow":
+                # Arrow ingest (the C-data-interface path of the
+                # reference, include/LightGBM/arrow.h): Tables /
+                # RecordBatches column-by-column, chunked arrays
+                # concatenated; per-column to_numpy is zero-copy for
+                # non-null numeric chunks
+                X, names = _extract_arrow(data)
+                if feature_name == "auto" and names:
+                    feature_name = names
+            elif isinstance(data, Sequence):
+                X = _materialize_sequences([data])
+            elif isinstance(data, (list, tuple)) and data \
+                    and all(isinstance(s, Sequence) for s in data):
+                X = _materialize_sequences(list(data))
             elif hasattr(data, "tocsr") or hasattr(data, "toarray"):
                 X = np.asarray(data.todense(), dtype=np.float64)
             elif isinstance(data, np.ndarray):

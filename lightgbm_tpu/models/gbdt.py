@@ -92,7 +92,10 @@ class GBDTBooster:
         ds = train_set
         self.n = ds.num_data()
         self.F = ds.num_features()
-        self.bins_T = ds.device_bins()            # [F, n]
+        # NOTE: the [F, n] device upload is deferred until after the
+        # EFB bundling decision below — uploading first would pin the
+        # full unbundled matrix in HBM alongside the bundled one
+        self.bins_T = None
         self.feat_num_bins = ds.device_feat_num_bins()
         self.feat_nan_bin = ds.device_feat_nan_bin()
         self.feat_is_cat = ds.device_feat_is_cat()
@@ -239,7 +242,6 @@ class GBDTBooster:
             binfo = ds.bundles(cfg)
             if binfo is not None:
                 self.bundle = binfo
-                self.bins_T = jnp.asarray(binfo.bins_bundled.T)
                 self._bundle_dev = (
                     jnp.asarray(binfo.bundle_of),
                     jnp.asarray(binfo.offset_of),
@@ -249,6 +251,10 @@ class GBDTBooster:
                     jnp.asarray(binfo.end_at))
                 self.grow_cfg = self.grow_cfg._replace(
                     bundled=True, num_bins=binfo.num_positions)
+        # only ONE training matrix ever reaches HBM: bundled when EFB
+        # engaged, the plain [F, n] matrix otherwise
+        self.bins_T = jnp.asarray(self.bundle.bins_bundled.T) \
+            if self.bundle is not None else ds.device_bins()
 
         # -- distributed setup: mesh instead of Network::Init ------------
         # (SURVEY.md §2.6: the socket/MPI linker layer disappears; rows

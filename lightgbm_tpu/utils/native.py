@@ -1,0 +1,101 @@
+"""Native extension loader: compile-on-first-use C++ via ctypes.
+
+The reference ships its runtime (text parsing, IO) as compiled C++
+(src/io/parser.cpp, text_reader.h). Here the native piece is built
+lazily with the system toolchain and loaded through ctypes — no
+pybind11, no install step; everything degrades to the numpy paths when
+a compiler is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import Optional
+
+import numpy as np
+
+from .log import log_warning
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                           "native")
+_LIB = None
+_LIB_TRIED = False
+
+
+def _build_dir() -> str:
+    d = os.environ.get("LIGHTGBM_TPU_BUILD_DIR") or os.path.join(
+        tempfile.gettempdir(), "lightgbm_tpu_native")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    """Compile (if needed) and dlopen the fastparse library."""
+    global _LIB, _LIB_TRIED
+    if _LIB is not None or _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
+        return None
+    src = os.path.join(_NATIVE_DIR, "fastparse.cpp")
+    if not os.path.exists(src):
+        return None
+    with open(src, "rb") as fh:
+        tag = hashlib.sha256(fh.read()).hexdigest()[:16]
+    so = os.path.join(_build_dir(), f"fastparse_{tag}.so")
+    if not os.path.exists(so):
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+               "-fopenmp", src, "-o", so]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+        except Exception as e:  # compiler missing / failed: fall back
+            log_warning(f"native fastparse build failed ({e}); "
+                        "falling back to numpy text parsing")
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError as e:
+        log_warning(f"native fastparse load failed ({e})")
+        return None
+    lib.ltpu_sniff.restype = ctypes.c_int
+    lib.ltpu_sniff.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_char)]
+    lib.ltpu_parse_dense.restype = ctypes.c_int64
+    lib.ltpu_parse_dense.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_char,
+        ctypes.c_int64, ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")]
+    _LIB = lib
+    return lib
+
+
+def parse_dense_text(path: str, skip_header: bool) -> Optional[np.ndarray]:
+    """Parse a delimited numeric file to [rows, cols] float64 with the
+    native parser; None when native is unavailable (caller falls back
+    to numpy)."""
+    lib = _load()
+    if lib is None:
+        return None
+    with open(path, "rb") as fh:
+        buf = fh.read()
+    rows = ctypes.c_int64()
+    cols = ctypes.c_int64()
+    delim = ctypes.c_char()
+    rc = lib.ltpu_sniff(buf, len(buf), int(skip_header),
+                        ctypes.byref(rows), ctypes.byref(cols),
+                        ctypes.byref(delim))
+    if rc != 0 or rows.value <= 0 or cols.value <= 0:
+        return None
+    out = np.empty((rows.value, cols.value), np.float64)
+    got = lib.ltpu_parse_dense(buf, len(buf), int(skip_header),
+                               delim.value, rows.value, cols.value, out)
+    if got != rows.value:
+        out = out[:got]
+    return out

@@ -153,3 +153,74 @@ def test_zero_boost_rounds():
                     lgb.Dataset(X, label=y), 0)
     assert bst.num_trees() == 0
     np.testing.assert_allclose(bst.predict(X[:3]), 0.0)
+
+
+def test_arrow_table_ingest():
+    pa = pytest.importorskip("pyarrow")
+    rs = np.random.RandomState(0)
+    Xn = rs.randn(600, 3)
+    y = (Xn[:, 0] > 0).astype(float)
+    table = pa.table({f"f{i}": Xn[:, i] for i in range(3)})
+    d = lgb.Dataset(table, label=y)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, d, num_boost_round=4)
+    assert bst.feature_name() == ["f0", "f1", "f2"]
+    ref = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, lgb.Dataset(Xn, label=y),
+                    num_boost_round=4)
+    np.testing.assert_allclose(bst.predict(Xn[:50]), ref.predict(Xn[:50]),
+                               rtol=1e-6)
+
+
+def test_sequence_ingest_matches_dense():
+    rs = np.random.RandomState(1)
+    X = rs.randn(900, 4)
+    y = (X[:, 1] > 0).astype(float)
+
+    class ArrSeq(lgb.Sequence):
+        batch_size = 128
+
+        def __init__(self, arr):
+            self.arr = arr
+
+        def __getitem__(self, idx):
+            return self.arr[idx]
+
+        def __len__(self):
+            return len(self.arr)
+
+    d = lgb.Dataset([ArrSeq(X[:400]), ArrSeq(X[400:])], label=y)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, d, num_boost_round=4)
+    ref = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, lgb.Dataset(X, label=y),
+                    num_boost_round=4)
+    np.testing.assert_allclose(bst.predict(X[:50]), ref.predict(X[:50]),
+                               rtol=1e-6)
+
+
+def test_streaming_push_rows():
+    rs = np.random.RandomState(2)
+    X = rs.randn(1000, 5)
+    y = (X[:, 0] + 0.3 * X[:, 2] > 0).astype(float)
+    ds = lgb.Dataset.init_streaming(1000, 5,
+                                    params={"verbosity": -1})
+    # out-of-order batches with metadata, like the reference's
+    # LGBM_DatasetPushRowsWithMetadata streaming tests
+    ds.push_rows(X[600:], start_row=600, label=y[600:])
+    ds.push_rows(X[:600], start_row=0, label=y[:600])
+    ds.mark_finished()
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, ds, num_boost_round=4)
+    ref = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, lgb.Dataset(X, label=y),
+                    num_boost_round=4)
+    np.testing.assert_allclose(bst.predict(X[:50]), ref.predict(X[:50]),
+                               rtol=1e-6)
+
+
+def test_streaming_push_incomplete_raises():
+    ds = lgb.Dataset.init_streaming(100, 3, params={"verbosity": -1})
+    ds.push_rows(np.zeros((40, 3)), start_row=0)
+    with pytest.raises(lgb.LightGBMError, match="unpushed"):
+        ds.mark_finished()
