@@ -88,16 +88,8 @@ def build_bundles(bins: np.ndarray, mappers,
       sparse_threshold: a feature joins a bundle only if at least this
         fraction of sampled rows sits in its zero bin.
     """
-    from .binning import MissingType
     n, F = bins.shape
     if F < 3:
-        return None
-    # the bundled split search evaluates only the missing-goes-right
-    # direction (find_best_split_bundled); a dataset with ANY
-    # missing-typed feature would lose that feature's missing-goes-left
-    # candidates as a "direct" singleton — refuse bundling outright so
-    # bundled training stays exactly equal to unbundled training
-    if any(m.missing_type != MissingType.NONE for m in mappers):
         return None
     rs = np.random.RandomState(seed)
     idx = rs.choice(n, size=min(n, sample_rows), replace=False) \

@@ -463,22 +463,20 @@ def find_best_split_bundled(hist: jnp.ndarray,
                             tloc_at: jnp.ndarray,
                             end_at: jnp.ndarray,
                             is_direct_f: jnp.ndarray,
+                            feat_nan_bin: jnp.ndarray,
                             feature_mask: jnp.ndarray,
                             p: SplitParams) -> SplitResult:
     """Best split over an EFB-bundled histogram (ops/bundling.py layout).
 
     Every candidate is one (bundle, position) cell:
     - direct (singleton) bundles behave exactly like the plain scan:
-      ``left = cum[position]`` with threshold = position;
+      ``left = cum[position]`` with threshold = position, INCLUDING the
+      dual missing-direction scan for features carrying a NaN bin
+      (multi-member bundles never do - eligibility excludes them);
     - multi-member bundles host member thresholds at their mapped
-      positions, with ``left = leaf_total - (range_end_cum - cum)`` —
+      positions, with ``left = leaf_total - (range_end_cum - cum)`` -
       the member's bin-0 mass reconstructed from the leaf totals (the
       FixHistogram / most_freq_bin trick, dataset.h:760).
-
-    Bundled mode is restricted to plain numerical features (no NaN
-    bins, no categoricals — Dataset eligibility guarantees it), so the
-    dual missing-direction scan collapses to the single
-    missing-goes-right direction.
     """
     G, B, _ = hist.shape
     dtype = hist.dtype
@@ -487,42 +485,65 @@ def find_best_split_bundled(hist: jnp.ndarray,
                          axis=-1)
     total = jnp.stack([parent_g, parent_h, parent_cnt]).astype(dtype)
 
-    cum = jnp.cumsum(h3, axis=1)                       # [G, B, 3]
-    cum_flat = cum.reshape(G * B, 3)
-    e = cum_flat[jnp.clip(end_at, 0, G * B - 1).reshape(-1)] \
-        .reshape(G, B, 3)
     has_member = member_at >= 0
     member_ix = jnp.maximum(member_at, 0)
     direct_pos = is_direct_f[member_ix] & has_member
-    left = jnp.where(direct_pos[:, :, None], cum,
-                     total[None, None, :] - (e - cum))
-    right = total[None, None, :] - left
-    lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
-    rg, rh, rc = right[..., 0], right[..., 1], right[..., 2]
-    valid = (
-        has_member & feature_mask[member_ix]
-        & (lc >= p.min_data_in_leaf) & (rc >= p.min_data_in_leaf)
-        & (lh >= p.min_sum_hessian_in_leaf)
-        & (rh >= p.min_sum_hessian_in_leaf)
-        & (lc > 0) & (rc > 0)
-    )
-    gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p)
+    # direct singletons may carry a NaN bin; exclude it from the prefix
+    # scan exactly like the plain search (missing rows join a side via
+    # the learned default direction, never the threshold)
+    nanb = jnp.where(direct_pos, feat_nan_bin[member_ix], -1)  # [G, B]
+    is_nan_pos = (tloc_at == nanb) & (nanb >= 0)
+    cum = jnp.cumsum(
+        h3 * (~is_nan_pos)[:, :, None].astype(dtype), axis=1)
+    cum_flat = cum.reshape(G * B, 3)
+    e = cum_flat[jnp.clip(end_at, 0, G * B - 1).reshape(-1)] \
+        .reshape(G, B, 3)
+    nan_idx = jnp.clip(nanb, 0, B - 1)
+    nan_stats = jnp.take_along_axis(
+        h3, jnp.broadcast_to(nan_idx[:, :, None], (G, B, 3)), axis=1)
+    nan_stats = nan_stats * (nanb >= 0)[:, :, None].astype(dtype)
+
+    def eval_left(left, extra_valid):
+        right = total[None, None, :] - left
+        lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
+        rg, rh, rc = right[..., 0], right[..., 1], right[..., 2]
+        valid = (
+            extra_valid & has_member & feature_mask[member_ix]
+            & (lc >= p.min_data_in_leaf) & (rc >= p.min_data_in_leaf)
+            & (lh >= p.min_sum_hessian_in_leaf)
+            & (rh >= p.min_sum_hessian_in_leaf)
+            & (lc > 0) & (rc > 0)
+        )
+        gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p)
+        return jnp.where(valid, gain, K_MIN_SCORE)
+
+    # direction 1: missing goes right
+    left1 = jnp.where(direct_pos[:, :, None], cum,
+                      total[None, None, :] - (e - cum))
+    g1 = eval_left(left1, jnp.ones((G, B), bool))
+    # direction 2: missing joins the left side (direct NaN features)
+    left2 = cum + nan_stats
+    g2 = eval_left(left2, nanb >= 0)
+
     parent_gain = leaf_gain(total[0], total[1], p)
-    net = jnp.where(valid, gain - parent_gain - p.min_gain_to_split,
-                    K_MIN_SCORE)
+    shift = parent_gain + p.min_gain_to_split
+    net = jnp.stack([g1 - shift, g2 - shift])          # [2, G, B]
+    net = jnp.where(jnp.isfinite(net), net, K_MIN_SCORE)
 
     flat = jnp.argmax(net)
-    g = flat // B
+    d = flat // (G * B)
+    g = (flat // B) % G
     pos = flat % B
     best = net.reshape(-1)[flat]
-    lgs, lhs, lcs = lg[g, pos], lh[g, pos], lc[g, pos]
-    rgs, rhs, rcs = rg[g, pos], rh[g, pos], rc[g, pos]
+    sel = jnp.where(d == 0, left1[g, pos], left2[g, pos])
+    lgs, lhs, lcs = sel[0], sel[1], sel[2]
+    rgs, rhs, rcs = total[0] - lgs, total[1] - lhs, total[2] - lcs
     return SplitResult(
         gain=jnp.where(jnp.isfinite(best), best, K_MIN_SCORE)
         .astype(dtype),
         feature=member_at[g, pos].astype(jnp.int32),
         threshold_bin=tloc_at[g, pos].astype(jnp.int32),
-        default_left=jnp.asarray(False),
+        default_left=(d == 1),
         is_cat=jnp.asarray(False),
         cat_mask=jnp.zeros((B,), jnp.bool_),
         left_sum_g=lgs, left_sum_h=lhs, left_count=lcs,

@@ -1,0 +1,81 @@
+"""Multi-controller (SPMD) training helpers.
+
+The reference's distributed data loading protocol
+(/root/reference/src/io/dataset_loader.cpp:1070
+``ConstructBinMappersFromTextData``): each rank loads its row shard,
+ranks find bins on disjoint feature subsets, and the serialized
+BinMappers are allgathered (:1228-1236) so every rank bins against
+IDENTICAL boundaries. The Dask layer then trains per-worker and keeps
+worker 0's model (python-package/lightgbm/dask.py:_train_part).
+
+Under JAX's multi-controller runtime the same protocol is three steps:
+``init_distributed`` (parallel/distributed.py) wires the processes,
+``sync_bin_mappers`` broadcasts process 0's mappers to all, and the
+ordinary mesh-parallel Booster trains SPMD — every process computes the
+identical replicated model, so there is no "keep worker 0's result"
+step at all.
+
+    from lightgbm_tpu.parallel import distributed, spmd
+    distributed.init_distributed(...)          # Network::Init analog
+    ds = spmd.distributed_dataset(my_shard_X, my_shard_y, params=...)
+    bst = lgb.train(params | {"tree_learner": "data"}, ds, 100)
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["sync_bin_mappers", "distributed_dataset"]
+
+
+def sync_bin_mappers(mappers: List) -> List:
+    """Make bin boundaries identical on every process: serialize
+    process 0's mappers and broadcast (the Network::Allgather of
+    serialized BinMappers, dataset_loader.cpp:1228, collapsed to a
+    one-to-all broadcast — process 0's sample decides, like rank-0
+    bin-merging in ConstructFromSampleData :723)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return mappers
+    from jax.experimental import multihost_utils
+    from ..ops.binning import BinMapper
+
+    payload = json.dumps([m.to_dict() for m in mappers]).encode()
+    # length-prefix so every process allocates the same buffer; only
+    # process 0's bytes matter (and only they fit the broadcast size —
+    # other ranks' serializations can be longer)
+    n = np.asarray([len(payload)], np.int32)
+    n = multihost_utils.broadcast_one_to_all(n)
+    buf = np.zeros(int(n[0]), np.uint8)
+    if jax.process_index() == 0:
+        buf[: len(payload)] = np.frombuffer(payload, np.uint8)
+    buf = multihost_utils.broadcast_one_to_all(buf)
+    dicts = json.loads(bytes(buf.tobytes()).decode())
+    return [BinMapper.from_dict(d) for d in dicts]
+
+
+def distributed_dataset(X, label=None, params: Optional[dict] = None,
+                        **kwargs):
+    """Build a Dataset from THIS process's row shard with bin
+    boundaries synchronized across all processes (rank-strided loading
+    + mapper sync, the LoadFromFile(rank, num_machines) analog)."""
+    from ..basic import Dataset
+
+    ds = Dataset(X, label=label, params=params, **kwargs)
+    ds.construct()
+    ds.mappers = sync_bin_mappers(ds.mappers)
+    # re-bin the local rows against the synchronized boundaries
+    import jax
+
+    if jax.process_count() > 1:
+        from ..ops.binning import bin_values
+
+        Xf = np.asarray(X, np.float64)
+        cols = [Xf[:, j] for j in ds._used_features]
+        ds._bins = bin_values(cols, ds.mappers)
+        ds._device_bins = None
+    return ds

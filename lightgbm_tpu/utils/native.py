@@ -27,9 +27,18 @@ _LIB_TRIED = False
 
 
 def _build_dir() -> str:
-    d = os.environ.get("LIGHTGBM_TPU_BUILD_DIR") or os.path.join(
-        tempfile.gettempdir(), "lightgbm_tpu_native")
-    os.makedirs(d, exist_ok=True)
+    """Per-user 0700 cache dir: a shared predictable /tmp path would
+    let another local user plant a .so at the known hash name
+    (CWE-379)."""
+    d = os.environ.get("LIGHTGBM_TPU_BUILD_DIR")
+    if not d:
+        base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+            os.path.expanduser("~"), ".cache")
+        d = os.path.join(base, "lightgbm_tpu", "native")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    st = os.stat(d)
+    if st.st_uid != os.getuid():
+        raise PermissionError(f"native build dir {d} not owned by us")
     return d
 
 
@@ -46,16 +55,28 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     with open(src, "rb") as fh:
         tag = hashlib.sha256(fh.read()).hexdigest()[:16]
-    so = os.path.join(_build_dir(), f"fastparse_{tag}.so")
+    try:
+        so = os.path.join(_build_dir(), f"fastparse_{tag}.so")
+    except PermissionError as e:
+        log_warning(f"native fastparse disabled: {e}")
+        return None
     if not os.path.exists(so):
+        # compile to a private temp name, then atomic-rename: a
+        # concurrent process never dlopens a half-written file
+        tmp = f"{so}.{os.getpid()}.tmp"
         cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-               "-fopenmp", src, "-o", so]
+               "-fopenmp", src, "-o", tmp]
         try:
             subprocess.run(cmd, check=True, capture_output=True,
                            timeout=120)
+            os.replace(tmp, so)
         except Exception as e:  # compiler missing / failed: fall back
             log_warning(f"native fastparse build failed ({e}); "
                         "falling back to numpy text parsing")
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             return None
     try:
         lib = ctypes.CDLL(so)

@@ -110,3 +110,37 @@ def test_bundling_skipped_with_dense_data():
     bst = lgb.train({"objective": "binary", "verbosity": -1},
                     lgb.Dataset(X, label=y), num_boost_round=3)
     assert bst._engine.bundle is None
+
+
+def test_bundling_engages_alongside_nan_feature():
+    """A NaN-carrying numeric column must NOT disable bundling for the
+    rest of the dataset: it stays a direct singleton (with its dual
+    missing-direction scan) while the sparse blocks bundle — and the
+    model equals the unbundled one structurally."""
+    rs = np.random.RandomState(13)
+    n = 2500
+    X_blocks, y = _sparse_onehot(n, groups=4, per_group=6, seed=13)
+    xnan = rs.randn(n, 1)
+    xnan[rs.rand(n) < 0.3] = np.nan
+    X = np.hstack([X_blocks, xnan])
+    y = ((np.nan_to_num(xnan[:, 0]) > 0.3) ^ (y > 0.5)).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=6)
+    assert bundled._engine.bundle is not None, "bundling did not engage"
+    for ta, tb in zip(plain._models, bundled._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        np.testing.assert_array_equal(ta.split_feature[:nn],
+                                      tb.split_feature[:nn])
+        np.testing.assert_array_equal(ta.threshold_bin[:nn],
+                                      tb.threshold_bin[:nn])
+        np.testing.assert_array_equal(
+            [ta.default_left(i) for i in range(nn)],
+            [tb.default_left(i) for i in range(nn)])
+    np.testing.assert_allclose(plain.predict(X[:200]),
+                               bundled.predict(X[:200]),
+                               rtol=5e-3, atol=1e-4)

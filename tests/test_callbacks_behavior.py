@@ -1,0 +1,87 @@
+"""Callback behavioral surface (reference callback.py semantics:
+reset_parameter schedules, early stopping with min_delta and
+first_metric_only, log/record interplay)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import make_synthetic_binary
+
+
+def _data(n=1500, f=5, seed=0):
+    X, y = make_synthetic_binary(n=n, f=f, seed=seed)
+    d = lgb.Dataset(X[: n - 300], label=y[: n - 300])
+    v = lgb.Dataset(X[n - 300:], label=y[n - 300:], reference=d)
+    return X, y, d, v
+
+
+def test_reset_parameter_learning_rate_schedule():
+    X, y, d, v = _data()
+    lrs = [0.3] * 3 + [0.05] * 5
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, d, num_boost_round=8,
+                    callbacks=[lgb.reset_parameter(learning_rate=lrs)])
+    # shrinkage changes are visible in the leaf magnitudes of the
+    # serialized trees: early trees scale ~6x the late ones
+    mags = [np.max(np.abs(t.leaf_value[: t.num_leaves]))
+            for t in bst._models]
+    assert np.mean(mags[:3]) > 2.5 * np.mean(mags[4:])
+
+    # callable schedule variant
+    bst2 = lgb.train({"objective": "binary", "verbosity": -1,
+                      "num_leaves": 7}, lgb.Dataset(X[:1200], label=y[:1200]),
+                     num_boost_round=6,
+                     callbacks=[lgb.reset_parameter(
+                         learning_rate=lambda i: 0.3 * (0.5 ** i))])
+    mags2 = [np.max(np.abs(t.leaf_value[: t.num_leaves]))
+             for t in bst2._models]
+    assert mags2[0] > mags2[-1]
+
+    # wrong-length list raises
+    with pytest.raises(ValueError):
+        lgb.train({"objective": "binary", "verbosity": -1},
+                  lgb.Dataset(X[:500], label=y[:500]), num_boost_round=4,
+                  callbacks=[lgb.reset_parameter(learning_rate=[0.1])])
+
+
+def test_early_stopping_min_delta_stops_sooner():
+    X, y, d, v = _data(seed=3)
+    kw = dict(params={"objective": "binary", "verbosity": -1,
+                      "num_leaves": 31, "metric": "binary_logloss",
+                      "learning_rate": 0.02},
+              train_set=d, num_boost_round=200, valid_sets=[v])
+    plain = lgb.train(callbacks=[lgb.early_stopping(10, verbose=False)],
+                      **kw)
+    delta = lgb.train(callbacks=[lgb.early_stopping(
+        10, verbose=False, min_delta=5e-3)], **kw)
+    # requiring a 5e-3 improvement per round must stop no later -
+    # and on this slow learning rate, strictly sooner
+    assert delta.best_iteration <= plain.best_iteration
+    assert delta.current_iteration() < 200
+
+
+def test_early_stopping_first_metric_only():
+    X, y, d, v = _data(seed=5)
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 31,
+              "metric": ["auc", "binary_logloss"],
+              "first_metric_only": True, "learning_rate": 0.05}
+    bst = lgb.train(params, d, num_boost_round=120, valid_sets=[v],
+                    callbacks=[lgb.early_stopping(8, verbose=False,
+                                                  first_metric_only=True)])
+    assert bst.best_iteration > 0
+    # the recorded best score is the first metric's (auc) entry
+    assert "auc" in bst.best_score.get("valid_0", {})
+
+
+def test_record_and_log_together_capture_stdv_free_entries():
+    X, y, d, v = _data(seed=7)
+    rec = {}
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "metric": "auc", "num_leaves": 7}, d,
+                    num_boost_round=5, valid_sets=[v],
+                    callbacks=[lgb.record_evaluation(rec),
+                               lgb.log_evaluation(period=2,
+                                                  show_stdv=False)])
+    assert len(rec["valid_0"]["auc"]) == 5
+    assert all(np.isfinite(rec["valid_0"]["auc"]))

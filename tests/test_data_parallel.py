@@ -154,3 +154,21 @@ def test_init_distributed_single_machine_noop():
     # num_machines=1 machine lists must not try to wire a cluster
     from lightgbm_tpu.parallel.distributed import init_distributed
     init_distributed(machines="localhost:12400")  # single entry: no-op
+
+
+def test_spmd_single_process_passthrough():
+    """sync_bin_mappers / distributed_dataset are identity on one
+    process (the num_machines=1 degenerate case)."""
+    import numpy as np
+    from lightgbm_tpu.parallel.spmd import distributed_dataset, \
+        sync_bin_mappers
+    rs = np.random.RandomState(0)
+    X = rs.randn(500, 4)
+    y = (X[:, 0] > 0).astype(float)
+    ds = distributed_dataset(X, label=y, params={"verbosity": -1})
+    assert ds.num_data() == 500
+    same = sync_bin_mappers(ds.mappers)
+    assert same is ds.mappers or len(same) == len(ds.mappers)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, ds, num_boost_round=3)
+    assert np.all(np.isfinite(bst.predict(X[:50])))
