@@ -643,6 +643,112 @@ class Dataset:
                        group=group, init_score=init_score,
                        params=params or self.params, position=position)
 
+    # -- reference-parity accessors (python-package basic.py Dataset) ----
+    _FIELD_GETTERS = {"label": "get_label", "weight": "get_weight",
+                      "init_score": "get_init_score",
+                      "position": "get_position", "group": "get_group"}
+
+    def get_field(self, field_name: str):
+        """Generic field accessor (Dataset.get_field)."""
+        getter = self._FIELD_GETTERS.get(field_name)
+        if getter is None:
+            raise LightGBMError(f"Unknown field {field_name}")
+        return getattr(self, getter)()
+
+    def set_field(self, field_name: str, data) -> "Dataset":
+        """Generic field setter (Dataset.set_field)."""
+        setter = {"label": self.set_label, "weight": self.set_weight,
+                  "init_score": self.set_init_score,
+                  "position": self.set_position,
+                  "group": self.set_group}.get(field_name)
+        if setter is None:
+            raise LightGBMError(f"Unknown field {field_name}")
+        return setter(data)
+
+    def get_data(self):
+        """The raw data this Dataset was built from (row-subset for
+        subset Datasets; None once freed via free_raw_data)."""
+        if self.data is not None and self.used_indices is not None:
+            return np.asarray(self.data)[np.asarray(self.used_indices)]
+        return self.data
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """Set of Datasets along the reference chain."""
+        chain = set()
+        node, hops = self, 0
+        while node is not None and hops < ref_limit:
+            chain.add(node)
+            node = node.reference
+            hops += 1
+        return chain
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        if self._handle is not None:
+            raise LightGBMError(
+                "Cannot set reference after the Dataset is constructed")
+        self.reference = reference
+        return self
+
+    def set_feature_name(self, feature_name: List[str]) -> "Dataset":
+        if self._handle is not None and feature_name is not None:
+            if len(feature_name) != self._F_total:
+                raise LightGBMError(
+                    f"Expected {self._F_total} feature names, got "
+                    f"{len(feature_name)}")
+            self._feature_names = [str(f) for f in feature_name]
+        else:
+            self.feature_name = feature_name
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        if self._handle is not None:
+            raise LightGBMError(
+                "Cannot set categorical feature after the Dataset is "
+                "constructed")
+        self.categorical_feature = categorical_feature
+        return self
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row-subset view constructed against this Dataset's bin
+        mappers (Dataset::CopySubrow analog; the cv() fold path)."""
+        from .engine import _subset_dataset
+        self.construct()
+        return _subset_dataset(self, np.asarray(used_indices, np.int64),
+                               params or self.params)
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Stack another constructed Dataset's features onto this one
+        (Dataset::AddFeaturesFrom, src/io/dataset.cpp)."""
+        self.construct()
+        other.construct()
+        if other._n != self._n:
+            raise LightGBMError(
+                "Cannot add features from a Dataset with a different "
+                "number of rows")
+        self._bins = np.hstack([self._bins, other._bins])
+        self.mappers = list(self.mappers) + list(other.mappers)
+        self._full_mappers = list(self._full_mappers) \
+            + list(other._full_mappers)
+        self._used_features = np.concatenate(
+            [self._used_features,
+             other._used_features + self._F_total]).astype(np.int32)
+        self._feature_names = list(self._feature_names) \
+            + list(other._feature_names)
+        self._F += other._F
+        self._F_total += other._F_total
+        self._cat_idx = set(self._cat_idx) | {
+            c + self._F_total - other._F_total for c in other._cat_idx}
+        self._device_bins = None
+        self._bundle_info = None
+        self._device_raw = None
+        if self._raw_numeric is not None \
+                and other._raw_numeric is not None:
+            self._raw_numeric = np.hstack([self._raw_numeric,
+                                           other._raw_numeric])
+        else:
+            self._raw_numeric = None
+        return self
+
     # -- device views ----------------------------------------------------
     def device_bins(self):
         """[F, n] bin matrix on device (feature-major; HBM-resident)."""
@@ -779,6 +885,7 @@ class Booster:
         self.best_iteration = -1
         self.best_score: Dict = {}
         self._train_data_name = "training"
+        self._attrs: Dict[str, str] = {}
         self.params = params or {}
         self._engine = None
         self._metrics = []
@@ -1010,6 +1117,53 @@ class Booster:
         return trees_to_dataframe(self)
 
     # -- misc reference-API methods ---------------------------------------
+    # -- reference-parity surface (python-package basic.py Booster) -----
+    @classmethod
+    def model_from_string(cls, model_str: str) -> "Booster":
+        """Load a Booster from a model-format string."""
+        return cls(model_str=model_str)
+
+    def attr(self, key: str) -> Optional[str]:
+        """Free-form string attribute (Booster::GetAttr analog)."""
+        return self._attrs.get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        """Set (value) or delete (None) string attributes."""
+        for k, v in kwargs.items():
+            if v is None:
+                self._attrs.pop(k, None)
+            else:
+                self._attrs[k] = str(v)
+        return self
+
+    def lower_bound(self) -> float:
+        """Smallest reachable raw score: sum over trees of each tree's
+        minimum leaf value (Booster::LowerBoundValue)."""
+        return float(sum(np.min(t.leaf_value[: t.num_leaves])
+                         for t in self._models) or 0.0)
+
+    def upper_bound(self) -> float:
+        """Largest reachable raw score (Booster::UpperBoundValue)."""
+        return float(sum(np.max(t.leaf_value[: t.num_leaves])
+                         for t in self._models) or 0.0)
+
+    def set_network(self, machines, local_listen_port: int = 12400,
+                    listen_time_out: int = 120,
+                    num_machines: int = 1) -> "Booster":
+        """Wire the multi-controller runtime (LGBM_NetworkInit analog;
+        on TPU the 'network' is the jax.distributed world)."""
+        from .parallel.distributed import init_distributed
+        if num_machines > 1:
+            init_distributed(machines=machines if isinstance(machines, str)
+                             else ",".join(machines))
+        return self
+
+    def free_network(self) -> "Booster":
+        """Tear the multi-controller runtime down (LGBM_NetworkFree)."""
+        from .parallel.distributed import shutdown_distributed
+        shutdown_distributed()
+        return self
+
     def set_train_data_name(self, name: str) -> "Booster":
         self._train_data_name = name
         return self

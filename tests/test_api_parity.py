@@ -1,0 +1,81 @@
+"""Reference python-package API-surface parity: generic field access,
+subset/add_features_from, ref chains, attrs, model_from_string, score
+bounds (basic.py Dataset/Booster method inventory)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture()
+def fitted():
+    rs = np.random.RandomState(0)
+    X = rs.randn(800, 5)
+    y = (X[:, 0] > 0).astype(float)
+    d = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, d, 5)
+    return X, y, d, bst
+
+
+def test_dataset_field_access_and_ref_chain(fitted):
+    X, y, d, _ = fitted
+    assert np.array_equal(d.get_field("label"), y)
+    d.set_field("weight", np.ones(len(y)))
+    assert np.allclose(d.get_field("weight"), 1.0)
+    assert d.get_data() is not None
+    v = d.create_valid(X[:100], label=y[:100])
+    assert d in v.get_ref_chain()
+    assert v in v.get_ref_chain()
+    with pytest.raises(lgb.LightGBMError):
+        d.get_field("nope")
+
+
+def test_dataset_subset_and_add_features(fitted):
+    X, y, d, _ = fitted
+    sub = d.subset(np.arange(0, 400))
+    assert sub.num_data() == 400
+    dA = lgb.Dataset(X[:, :3].copy(), label=y)
+    dB = lgb.Dataset(X[:, 3:].copy(), label=y)
+    dA.construct(), dB.construct()
+    dA.add_features_from(dB)
+    assert dA.num_features() == 5
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 7}, dA, 3)
+    assert np.all(np.isfinite(bst.predict(X[:50])))
+
+
+def test_booster_attrs_and_model_from_string(fitted):
+    X, _, _, bst = fitted
+    b2 = lgb.Booster.model_from_string(bst.model_to_string())
+    np.testing.assert_allclose(bst.predict(X[:50]), b2.predict(X[:50]),
+                               rtol=1e-6)
+    bst.set_attr(note="hello", run="1")
+    assert bst.attr("note") == "hello"
+    assert bst.attr("run") == "1"
+    bst.set_attr(note=None)
+    assert bst.attr("note") is None
+
+
+def test_booster_score_bounds(fitted):
+    X, _, _, bst = fitted
+    lo, hi = bst.lower_bound(), bst.upper_bound()
+    raw = bst.predict(X, raw_score=True)
+    assert raw.min() >= lo - 1e-6
+    assert raw.max() <= hi + 1e-6
+
+
+def test_set_reference_and_feature_names():
+    rs = np.random.RandomState(1)
+    X = rs.randn(300, 3)
+    y = (X[:, 0] > 0).astype(float)
+    d = lgb.Dataset(X, label=y)
+    v = lgb.Dataset(X[:50], label=y[:50])
+    v.set_reference(d)
+    assert v.reference is d
+    d.set_feature_name(["a", "b", "c"])
+    d.construct()
+    assert d.get_feature_name() == ["a", "b", "c"]
+    with pytest.raises(lgb.LightGBMError):
+        d.set_categorical_feature([0])  # after construct

@@ -1,0 +1,61 @@
+"""CPU/TPU dual parity (the reference's env-gated test_dual.py): the
+same data trains on both backends with approximately equal quality.
+
+Gated on LIGHTGBM_TPU_TEST_DUAL=1 because it needs a real accelerator
+next to the CPU path (the conftest pins the suite to CPU; this test
+spawns a subprocess on the ambient backend instead)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from conftest import make_synthetic_binary
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("LIGHTGBM_TPU_TEST_DUAL", "") != "1",
+    reason="set LIGHTGBM_TPU_TEST_DUAL=1 (needs an accelerator)")
+
+_CHILD = r"""
+import json, sys
+import numpy as np
+import lightgbm_tpu as lgb
+rs = np.random.RandomState(7)
+X = rs.randn(20000, 10)
+y = ((X[:, 0] + 0.5 * X[:, 1] * X[:, 2]) > 0).astype(float)
+bst = lgb.train({"objective": "binary", "verbosity": -1,
+                 "num_leaves": 31}, lgb.Dataset(X[:16000], label=y[:16000]),
+                num_boost_round=20)
+p = bst.predict(X[16000:])
+yv = y[16000:]
+ll = -np.mean(yv * np.log(np.clip(p, 1e-12, 1))
+              + (1 - yv) * np.log(np.clip(1 - p, 1e-12, 1)))
+import jax
+print(json.dumps({"backend": jax.default_backend(), "logloss": float(ll)}))
+"""
+
+
+def test_cpu_accelerator_logloss_parity():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # ambient accelerator
+    out = subprocess.run([sys.executable, "-c", _CHILD], env=env,
+                         capture_output=True, text=True, timeout=1800,
+                         cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert out.returncode == 0, out.stderr[-2000:]
+    acc = json.loads(out.stdout.strip().splitlines()[-1])
+
+    env_cpu = dict(env, JAX_PLATFORMS="cpu")
+    out2 = subprocess.run([sys.executable, "-c", _CHILD], env=env_cpu,
+                          capture_output=True, text=True, timeout=1800,
+                          cwd=os.path.dirname(os.path.dirname(__file__)))
+    assert out2.returncode == 0, out2.stderr[-2000:]
+    cpu = json.loads(out2.stdout.strip().splitlines()[-1])
+
+    # single-precision histogram parity bound (the reference's dual
+    # test allows 1e-4 relative for single precision)
+    assert abs(acc["logloss"] - cpu["logloss"]) \
+        <= 1e-2 * max(1.0, cpu["logloss"]), (acc, cpu)
