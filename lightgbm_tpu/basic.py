@@ -669,7 +669,10 @@ class Dataset:
         """The raw data this Dataset was built from (row-subset for
         subset Datasets; None once freed via free_raw_data)."""
         if self.data is not None and self.used_indices is not None:
-            return np.asarray(self.data)[np.asarray(self.used_indices)]
+            idx = np.asarray(self.used_indices)
+            if hasattr(self.data, "iloc"):
+                return self.data.iloc[idx]
+            return np.asarray(self.data)[idx]
         return self.data
 
     def get_ref_chain(self, ref_limit: int = 100):
@@ -690,6 +693,10 @@ class Dataset:
         return self
 
     def set_feature_name(self, feature_name: List[str]) -> "Dataset":
+        if feature_name == "auto":
+            # the documented default sentinel: keep current names
+            # (python-package Dataset.set_feature_name semantics)
+            return self
         if self._handle is not None and feature_name is not None:
             if len(feature_name) != self._F_total:
                 raise LightGBMError(

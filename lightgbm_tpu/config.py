@@ -497,6 +497,11 @@ class Config:
     # tree grower: compact (rows grouped by leaf; per-split work ~ leaf
     # size) | masked (full-row masked histogram passes)
     grower: str = "compact"
+    # rows per streaming chunk in the compact grower's partition pass
+    # (perf knob; power of two. Larger chunks amortize per-chunk fixed
+    # costs but pay more window-tail padding and higher per-row sort
+    # depth — 16384 measured best on v5e, benchmarks/PROFILE.md)
+    chunk_rows: int = 16384
 
     # Unrecognized parameters are kept here (warned about, not fatal).
     extra: Dict[str, Any] = field(default_factory=dict)
@@ -570,6 +575,10 @@ class Config:
                 f"{self.monotone_constraints_method}")
         if self.hist_method not in ("auto", "scatter", "mxu"):
             raise ValueError(f"Unknown hist_method: {self.hist_method}")
+        if self.chunk_rows < 256 or (self.chunk_rows
+                                     & (self.chunk_rows - 1)) != 0:
+            raise ValueError("chunk_rows must be a power of two >= 256, "
+                             f"got {self.chunk_rows}")
         if self.hist_precision not in ("default", "high", "highest"):
             raise ValueError(
                 f"Unknown hist_precision: {self.hist_precision}")

@@ -27,6 +27,7 @@ import numpy as np
 
 from ..config import Config
 from ..objectives import Objective
+from ..ops.gather import gather_small
 from ..ops.grow import GrowConfig, TreeArrays, grow_tree
 from ..ops.predict import predict_leaf_binned
 from ..ops.renew import renew_leaf_values
@@ -193,6 +194,7 @@ class GBDTBooster:
             num_bins=ds.num_total_bins(),
             max_depth=cfg.max_depth,
             grower=grower,
+            chunk=cfg.chunk_rows,
             hist_method=hist_method,
             hist_precision=cfg.hist_precision,
             quantized=cfg.use_quantized_grad,
@@ -588,7 +590,8 @@ class GBDTBooster:
             # first iteration's trees stay constant
             # (linear_tree_learner.cpp:185-190 is_first_tree path)
             return (dev_tree.leaf_value, None,
-                    dev_tree.leaf_value[row_leaf], [[] for _ in range(L)], 0)
+                    gather_small(dev_tree.leaf_value, row_leaf),
+                    [[] for _ in range(L)], 0)
         feats = branch_features_per_leaf(
             np.asarray(dev_tree.split_feature),
             np.asarray(dev_tree.left_child),
@@ -598,7 +601,7 @@ class GBDTBooster:
         kmax = max((len(f) for f in feats), default=0)
         if kmax == 0:
             return (dev_tree.leaf_value, None,
-                    dev_tree.leaf_value[row_leaf], feats, 0)
+                    gather_small(dev_tree.leaf_value, row_leaf), feats, 0)
         lf = np.zeros((L, kmax), np.int32)
         nf = np.zeros((L,), np.int32)
         for i, f in enumerate(feats):
@@ -946,7 +949,7 @@ class GBDTBooster:
                 self._tree_weights.append(1.0)
 
             contrib_raw = lin[2] if lin is not None \
-                else leaf_values[row_leaf]
+                else gather_small(leaf_values, row_leaf)
             if defer:
                 # a no-growth tree is replaced by a constant at flush
                 # (AsConstantTree, gbdt.cpp): contribute nothing here

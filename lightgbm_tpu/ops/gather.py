@@ -1,0 +1,41 @@
+"""Small-table row gathers that compile well on TPU.
+
+``table[idx]`` with a million-row ``idx`` and a tiny table lowers to an
+XLA gather that TPUs execute one element at a time (~8.6 ms per million
+rows measured — benchmarks/PROFILE.md). The boosting loop needs exactly
+this shape in several places (leaf value -> row score contribution, the
+reference's ScoreUpdater::AddScore walk, score_updater.hpp:58): a [n]
+index vector into an [L <= a few hundred] table. ``gather_small``
+replaces it with L sequential full-width selects — O(L * n / lanes)
+vector work, ~30x faster at L=255 — while keeping exact dtype semantics
+(values are moved bit-for-bit, never re-rounded).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["gather_small"]
+
+
+@jax.jit
+def gather_small(table: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """``table[idx]`` via a fori_loop of vector selects.
+
+    Args:
+      table: ``[L]`` values (any dtype); L is static and small.
+      idx: ``[n]`` int indices into the table (out-of-range behaves as
+        "unchanged zero", matching XLA's drop semantics closely enough
+        for in-range callers).
+    Returns:
+      ``[n]`` array of ``table.dtype``.
+    """
+    L = table.shape[0]
+    init = jnp.zeros(idx.shape, table.dtype)
+
+    def body(l, acc):
+        return jnp.where(idx == l, table[l], acc)
+
+    return lax.fori_loop(0, L, body, init)

@@ -36,7 +36,9 @@ from .split import (SplitParams, SplitResult, constrained_output,
                     find_best_split, find_best_split_bundled,
                     gain_at_output, leaf_gain, leaf_output)
 
-__all__ = ["GrowConfig", "TreeArrays", "grow_tree"]
+from .partition_kernel import route_concentrate
+
+__all__ = ["GrowConfig", "TreeArrays", "grow_tree", "route_concentrate"]
 
 NEG_INF = -jnp.inf
 
@@ -106,6 +108,17 @@ class GrowConfig(NamedTuple):
     # Exclusive Feature Bundling (ops/bundling.py): bins_T holds bundle
     # columns and the split search runs in bundle-position space
     bundled: bool = False
+    # in-chunk stable partition primitive (compact grower):
+    # "sort"  — one variadic lax.sort on a (side, position) key.
+    #           Default: XLA:TPU's variadic sort measures ~35us per
+    #           16K chunk in situ (xplane, benchmarks/PROFILE.md) —
+    #           NOT the chunk bottleneck.
+    # "route" — two butterfly concentration passes (log2(K) stages of
+    #           stride exchanges, LSB-first) steered by destination
+    #           bits (ops/partition_kernel.py). Fewer stages on paper,
+    #           but Mosaic/XLA lower the stage chain poorly on TPU
+    #           today; kept as an option + correctness oracle.
+    partition: str = "sort"
 
 
 class TreeArrays(NamedTuple):
@@ -485,18 +498,32 @@ class _CompactState(NamedTuple):
 _IB_BIT = jnp.uint32(1 << 31)
 
 
-def _leaf_of_positions(leaf_begin, leaf_count, n, L):
-    """[n] leaf id per grouped position: ranges partition [0, n); mark
-    each active range start, prefix-sum to a segment id, map segments to
-    leaves via the begin-sorted leaf list."""
+def _leaf_values_at_positions(leaf_begin, leaf_count, values, n):
+    """Spread per-leaf int ``values`` onto the [n] grouped positions
+    (ranges partition [0, n)).
+
+    At each active range start, scatter the DELTA between consecutive
+    begin-sorted leaves' values (an L-sized scatter — cheap), then one
+    [n] cumsum materializes the value per position. No [n]-sized
+    gather: XLA:TPU serializes gathers per element (~8.6 ms per
+    million rows measured, benchmarks/PROFILE.md), while scatter-of-L
+    + cumsum is pure vector work."""
     active = leaf_count > 0
     keys = jnp.where(active, leaf_begin, n + 1)
     ls = jnp.argsort(keys)  # leaves ordered by begin, inactive last
     flag = active[ls].astype(jnp.int32)
+    v = values[ls].astype(jnp.int32)
+    prev = jnp.concatenate([jnp.zeros((1,), v.dtype), v[:-1]])
+    delta = (v - prev) * flag
     marks = jnp.zeros((n,), jnp.int32).at[
-        jnp.clip(leaf_begin[ls], 0, n - 1)].add(flag)
-    seg = jnp.cumsum(marks) - 1
-    return ls[jnp.clip(seg, 0, L - 1)].astype(jnp.int32)
+        jnp.clip(leaf_begin[ls], 0, n - 1)].add(delta)
+    return jnp.cumsum(marks)
+
+
+def _leaf_of_positions(leaf_begin, leaf_count, n, L):
+    """[n] leaf id per grouped position (see _leaf_values_at_positions)."""
+    return _leaf_values_at_positions(leaf_begin, leaf_count,
+                                     jnp.arange(L, dtype=jnp.int32), n)
 
 
 def _row_leaf_from_order(order, leaf_of_pos):
@@ -551,6 +578,9 @@ def _grow_compact_impl(cfg: GrowConfig,
     while K >= 2 * n:
         K //= 2
     K = max(K, 256)
+    route = cfg.partition == "route"
+    if route:
+        K = 1 << (K.bit_length() - 1)   # butterfly needs a power of two
 
     fp = cfg.axis_name is not None and cfg.parallel_mode == "feature"
     vp = cfg.axis_name is not None and cfg.parallel_mode == "voting"
@@ -833,6 +863,15 @@ def _grow_compact_impl(cfg: GrowConfig,
                                      (s, 0), (K, a.shape[1]))
         return lax.dynamic_slice(jnp.concatenate([a, a]), (s,), (K,))
 
+    # bf16 payload storage on TPU: the streamed (g, h) pairs only ever
+    # feed the MXU histogram, whose single-pass default truncates f32
+    # inputs to bf16 anyway — so storing them as bf16 is numerically
+    # IDENTICAL on TPU while halving payload bytes in every chunk
+    # slice/sort/write (and packing the pair into one u32 sort column).
+    # Exact float sums (root totals, leaf renewal) read the original
+    # f32 gw2, never pay2. CPU keeps f32: its matmuls don't truncate.
+    bf16_pay = (not quant) and jax.default_backend() == "tpu" \
+        and cfg.hist_method != "scatter" and cfg.hist_precision == "default"
     if quant:
         # int8 (g, h) pairs ride the sort as ONE u16 column
         def _pack_pay(blk_p):
@@ -842,6 +881,16 @@ def _grow_compact_impl(cfg: GrowConfig,
         def _unpack_pay(cols):
             return lax.bitcast_convert_type(cols[0][:, None],
                                             jnp.int8).reshape(K, 2)
+        NPAY = 1
+    elif bf16_pay:
+        # bf16 (g, h) pairs ride the sort as ONE u32 column
+        def _pack_pay(blk_p):
+            return (lax.bitcast_convert_type(
+                blk_p.reshape(K, 1, 2), jnp.uint32)[:, 0],)
+
+        def _unpack_pay(cols):
+            return lax.bitcast_convert_type(cols[0][:, None],
+                                            jnp.bfloat16).reshape(K, 2)
         NPAY = 1
     else:
         def _pack_pay(blk_p):
@@ -928,7 +977,7 @@ def _grow_compact_impl(cfg: GrowConfig,
                 hp = blk_p * hmask[:, None].astype(jnp.int8)
                 hist = hist + hist_from_rows_int(blk_b, hp, B, hmethod)
             else:
-                hp = blk_p * hmask[:, None].astype(dtype)
+                hp = blk_p * hmask[:, None].astype(blk_p.dtype)
                 hist = hist + hist_from_rows(blk_b, hp, B, hmethod,
                                              cfg.hist_precision)
             if cegb_lazy:
@@ -940,28 +989,42 @@ def _grow_compact_impl(cfg: GrowConfig,
                 # the leaf (UpdateLeafBestSplits' InsertBitset loop
                 # over the bagged partition)
                 lazy_used = lazy_used.at[rows, f].max(valid & blk_i)
-            # stable in-chunk partition: one variadic sort moving all
-            # row data by a (side, position) key
-            side = jnp.where(vl, 0, jnp.where(valid, 1, 2))
-            key = side * K + iota_k
-            ops = lax.sort((key,) + _pack_bins(blk_b)
-                           + _pack_pay(blk_p) + (blk_o,), num_keys=1)
-            pb = _unpack_bins(ops[1:1 + NW])
-            pp = _unpack_pay(ops[1 + NW:1 + NW + NPAY])
-            po = ops[1 + NW + NPAY]
-            # lefts [0, l_c) forward in place
+            cols = _pack_bins(blk_b) + _pack_pay(blk_p) + (blk_o,)
             ml = iota_k < l_c
-            bins2 = write(bins2, src_base + l_off, pb, ml)
-            pay2 = write(pay2, src_base + l_off, pp, ml)
-            ord2 = write(ord2, src_base + l_off, po, ml)
-            # rights [l_c, l_c+r_c) rotated to the block END, packed
-            # backward from the window end in the other half
-            s_r = lax.rem(l_c + r_c, jnp.asarray(K, jnp.int32))
             o_r = dst_base + cnt - r_off - K
             mr = iota_k >= (K - r_c)
-            bins2 = write(bins2, o_r, rot(pb, s_r), mr)
-            pay2 = write(pay2, o_r, rot(pp, s_r), mr)
-            ord2 = write(ord2, o_r, rot(po, s_r), mr)
+            if route:
+                # two butterfly concentrations: lefts compact to the
+                # block FRONT, rights directly to the block END (no
+                # rotate needed — the offset is part of the route).
+                lops = route_concentrate(cols, vl, jnp.int32(0))
+                rops = route_concentrate(cols, valid & ~gl, K - r_c)
+                lb = _unpack_bins(lops[:NW])
+                lp = _unpack_pay(lops[NW:NW + NPAY])
+                lo = lops[NW + NPAY]
+                rb = _unpack_bins(rops[:NW])
+                rp = _unpack_pay(rops[NW:NW + NPAY])
+                ro = rops[NW + NPAY]
+            else:
+                # stable in-chunk partition: one variadic sort moving
+                # all row data by a (side, position) key
+                side = jnp.where(vl, 0, jnp.where(valid, 1, 2))
+                key = side * K + iota_k
+                ops = lax.sort((key,) + cols, num_keys=1)
+                lb = _unpack_bins(ops[1:1 + NW])
+                lp = _unpack_pay(ops[1 + NW:1 + NW + NPAY])
+                lo = ops[1 + NW + NPAY]
+                # rights [l_c, l_c+r_c) rotated to the block END
+                s_r = lax.rem(l_c + r_c, jnp.asarray(K, jnp.int32))
+                rb, rp, ro = rot(lb, s_r), rot(lp, s_r), rot(lo, s_r)
+            # lefts [0, l_c) forward in place; rights packed backward
+            # from the window end in the other half
+            bins2 = write(bins2, src_base + l_off, lb, ml)
+            pay2 = write(pay2, src_base + l_off, lp, ml)
+            ord2 = write(ord2, src_base + l_off, lo, ml)
+            bins2 = write(bins2, o_r, rb, mr)
+            pay2 = write(pay2, o_r, rp, mr)
+            ord2 = write(ord2, o_r, ro, mr)
             return (bins2, pay2, ord2, lazy_used, hist, nu,
                     l_off + l_c, r_off + r_c, nlib, nib)
 
@@ -1045,7 +1108,8 @@ def _grow_compact_impl(cfg: GrowConfig,
                       jnp.asarray(True))
     hists = jnp.zeros((L, F, B, 2),
                       jnp.int32 if quant else dtype).at[0].set(root_hist)
-    pay0 = gw2_q if quant else gw2
+    pay0 = gw2_q if quant \
+        else (gw2.astype(jnp.bfloat16) if bf16_pay else gw2)
     ord0 = jnp.arange(n, dtype=jnp.uint32) \
         | jnp.where(inbag, _IB_BIT, jnp.uint32(0))
     state = _CompactState(
@@ -1380,7 +1444,8 @@ def _grow_compact_impl(cfg: GrowConfig,
     # into one coherent order vector, then invert
     leaf_of_pos = _leaf_of_positions(state.leaf_begin, state.leaf_count,
                                      n, L)
-    in_b1 = state.leaf_buf[leaf_of_pos] == 1
+    in_b1 = _leaf_values_at_positions(state.leaf_begin, state.leaf_count,
+                                      state.leaf_buf, n) == 1
     order_m = jnp.where(in_b1, state.ord2[SEG + K: SEG + K + n],
                         state.ord2[K: K + n])
     order_ids = (order_m & ~_IB_BIT).astype(jnp.int32)

@@ -45,9 +45,23 @@ from jax import lax
 __all__ = ["build_histogram", "subtract_histogram", "hist_from_rows",
            "hist_from_rows_int", "PACK"]
 
-PACK = 8          # features per MXU pack (PACK * 16 = 128 lanes)
-ROW_BLOCK = 8192  # rows per accumulation block (bounds one-hot residency
-                  # AND keeps int-as-bf16 block sums exact: 8192*127 < 2^24)
+PACK = 4           # features per MXU pack. The matmul computes all
+                   # PACK x PACK cross-feature blocks and keeps the
+                   # diagonal, so FLOPs per feature scale with PACK —
+                   # while the materialized one-hot bytes per feature
+                   # (s_hi + s_lo*C values) don't depend on it.
+                   # Measured on v5e (benchmarks/PROFILE.md): PACK=4
+                   # beats 8 (half the FLOPs) and 2 (whose M=16 matmul
+                   # streams the MXU poorly).
+S_LO = 16          # bins per low-digit group: b = S_LO*hi + lo. With
+                   # PACK=4 the 16x16 split keeps the matmul N dim at
+                   # PACK*S_LO*C = 128 — exactly the MXU's output lanes
+                   # — and sits at the one-hot byte optimum
+                   # min(s_hi + s_lo*C) s.t. s_hi*s_lo >= num_bins.
+ROW_BLOCK = 16384  # rows per accumulation block (bounds one-hot residency
+                   # AND keeps int-as-bf16 block sums exact:
+                   # 16384*127 = 2.1M < 2^24; sized to the compact
+                   # grower's chunk so a chunk histogram is ONE block)
 
 _PRECISIONS = {
     "default": None,
@@ -60,41 +74,57 @@ def _nibble_hist_block(rows: jnp.ndarray, payload: jnp.ndarray,
                        s_hi: int, precision, int_exact: bool) -> jnp.ndarray:
     """One row-block of the nibble-decomposed MXU histogram.
 
-    ``hist[f, b] = sum_r [bins[r,f]==b] * payload[r]`` with ``b = 16*hi+lo``
-    factors into ``sum_r HI[r, f*s_hi+hi] * LO[r, f*16+lo] * payload[r]``:
-    a dense [128, S] x [S, 256] matmul per PACK-feature group — the MXU
-    replacement for the CUDA shared-memory scatter-add
+    ``hist[f, b] = sum_r [bins[r,f]==b] * payload[r]`` with
+    ``b = S_LO*hi + lo`` factors into
+    ``sum_r HI[r, f*s_hi+hi] * LO[r, f*S_LO+lo] * payload[r]``:
+    a dense [PACK*s_hi, S] x [S, PACK*S_LO*C] matmul per PACK-feature
+    group — the MXU replacement for the CUDA shared-memory scatter-add
     (/root/reference/src/treelearner/cuda/cuda_histogram_constructor.cu:18).
     Cross-feature (p != q) blocks of the product are computed and
     discarded; the MXU does them for free within the 128-lane tile.
 
     Args:
-      rows: ``[S, npacks, PACK]`` int32 bin values.
+      rows: ``[S, npacks, PACK]`` native-width (u8/u16) bin values —
+        kept narrow so the materialized compare operands stay small.
       payload: ``[S, C]`` float or int8 channels (grad, hess).
     Returns:
-      ``[npacks, PACK, s_hi * 16, C]`` partial histograms, f32 (exact
+      ``[npacks, PACK, s_hi * S_LO, C]`` partial histograms, f32 (exact
       integers when ``int_exact``).
     """
     S, npacks, P = rows.shape
     C = payload.shape[-1]
-    onehot_dtype = jnp.bfloat16 if int_exact else payload.dtype
+    # bf16 one-hots whenever the TPU matmul runs in single-pass mode:
+    # the MXU truncates DEFAULT-precision f32 inputs to bf16 anyway,
+    # and {0,1} masks commute with truncation (LOC is pay-or-zero), so
+    # the result is bit-identical on TPU while the materialized
+    # one-hot traffic — the measured cost center of the whole
+    # histogram (xplane, benchmarks/PROFILE.md) — halves. Multi-pass
+    # "high"/"highest" emulation needs true f32 operands, and CPU
+    # matmuls don't truncate, so both keep the payload dtype there.
+    bf16_pass = int_exact or (precision is None
+                              and jax.default_backend() == "tpu")
+    onehot_dtype = jnp.bfloat16 if bf16_pass else payload.dtype
     if int_exact:
-        payload = payload.astype(jnp.bfloat16)
         precision = None
-    hi = rows // 16
-    lo = rows & 15
-    HI = (hi[..., None] == jnp.arange(s_hi)).astype(onehot_dtype)
-    LO = (lo[..., None] == jnp.arange(16)).astype(onehot_dtype)
-    LOC = LO[..., None] * payload[:, None, None, None, :]  # [S,np,P,16,C]
+    if bf16_pass:
+        payload = payload.astype(jnp.bfloat16)
+    rdt = rows.dtype
+    hi = rows // rdt.type(S_LO)
+    lo = rows & rdt.type(S_LO - 1)
+    HI = (hi[..., None] == jnp.arange(s_hi, dtype=rdt)) \
+        .astype(onehot_dtype)
+    LO = (lo[..., None] == jnp.arange(S_LO, dtype=rdt)) \
+        .astype(onehot_dtype)
+    LOC = LO[..., None] * payload[:, None, None, None, :]  # [S,np,P,sl,C]
     out = jnp.einsum(
         "snx,snyc->nxyc",
         HI.reshape(S, npacks, P * s_hi),
-        LOC.reshape(S, npacks, P * 16, C),
+        LOC.reshape(S, npacks, P * S_LO, C),
         preferred_element_type=jnp.float32,
         precision=precision)
-    d = jnp.diagonal(out.reshape(npacks, P, s_hi, P, 16, C),
-                     axis1=1, axis2=3)                    # [np,hi,16,C,P]
-    return d.transpose(0, 4, 1, 2, 3).reshape(npacks, P, s_hi * 16, C)
+    d = jnp.diagonal(out.reshape(npacks, P, s_hi, P, S_LO, C),
+                     axis1=1, axis2=3)                    # [np,hi,sl,C,P]
+    return d.transpose(0, 4, 1, 2, 3).reshape(npacks, P, s_hi * S_LO, C)
 
 
 def _hist_from_rows_impl(rows: jnp.ndarray, payload: jnp.ndarray,
@@ -105,13 +135,15 @@ def _hist_from_rows_impl(rows: jnp.ndarray, payload: jnp.ndarray,
     int_exact = jnp.issubdtype(accum_dtype, jnp.integer)
     S, F = rows.shape
     C = payload.shape[-1]
-    s_hi = -(-num_bins // 16)
+    s_hi = -(-num_bins // S_LO)
     f_pad = (-F) % PACK
     if f_pad:
         rows = jnp.pad(rows, ((0, 0), (0, f_pad)))
     Fp = F + f_pad
     npacks = Fp // PACK
-    rows = rows.astype(jnp.int32).reshape(S, npacks, PACK)
+    if not jnp.issubdtype(rows.dtype, jnp.unsignedinteger):
+        rows = rows.astype(jnp.uint32)
+    rows = rows.reshape(S, npacks, PACK)
 
     def finish(block):
         return block.astype(accum_dtype) if int_exact else block
@@ -133,9 +165,9 @@ def _hist_from_rows_impl(rows: jnp.ndarray, payload: jnp.ndarray,
             blk = _nibble_hist_block(r, p, s_hi, precision, int_exact)
             return acc + finish(blk), None
 
-        init = jnp.zeros((npacks, PACK, s_hi * 16, C), accum_dtype)
+        init = jnp.zeros((npacks, PACK, s_hi * S_LO, C), accum_dtype)
         h, _ = lax.scan(body, init, (rows_b, pay_b))
-    h = h.reshape(Fp, s_hi * 16, C)
+    h = h.reshape(Fp, s_hi * S_LO, C)
     return h[:F, :num_bins, :]
 
 
@@ -155,8 +187,9 @@ def hist_from_rows(rows: jnp.ndarray, payload: jnp.ndarray,
       ``[F, B, C]`` histograms (padding features report zeros only if the
       caller masked their payload; callers crop to the true F).
     """
+    acc = jnp.promote_types(payload.dtype, jnp.float32)
     return _hist_from_rows_impl(rows, payload, num_bins, method,
-                                payload.dtype, _PRECISIONS[precision])
+                                acc, _PRECISIONS[precision])
 
 
 def hist_from_rows_int(rows: jnp.ndarray, payload: jnp.ndarray,

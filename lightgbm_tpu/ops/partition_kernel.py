@@ -1,0 +1,164 @@
+"""Butterfly-route partition: the compact grower's in-chunk primitive.
+
+A split streams each leaf window in K-row chunks; every chunk must be
+stably two-way partitioned (lefts to the block front, rights packed to
+the block end) before the masked window writes (ops/grow.py
+``part_apply``). The reference's GPU learner does this with a warp
+prefix-scan + scatter (/root/reference/src/treelearner/cuda/
+cuda_data_partition.cu: GenDataToLeftBitVector + SplitInner); TPUs have
+no per-lane scatter, so the redesign routes rows through a butterfly:
+
+- each marked row's destination is ``offset + stable rank`` (one prefix
+  sum);
+- stage ``s`` exchanges partners at stride ``2^s`` (LSB-first); a pair
+  swaps when the low slot's row needs destination bit ``s`` set or the
+  high slot's row needs it clear; don't-care rows yield. An
+  order-preserving partial route is congestion-free on the butterfly
+  (the classic SIMD concentrator-routing result), so ``log2(K)`` stages
+  of vector selects replace an ``O(log^2 K)``-stage variadic
+  ``lax.sort`` — ~14 vs ~196 stages at K=16384.
+
+Two implementations:
+
+- :func:`route_pair` — a Pallas TPU kernel that runs BOTH concentration
+  passes (lefts, rights) over the stacked column matrix in one VMEM
+  residency: loads the [NC, K] chunk once, does all stages on-chip, and
+  writes the two routed copies. This is the TPU analog of the CUDA
+  split kernel's shared-memory residency.
+- :func:`route_concentrate` — the same routing as plain XLA ops (flat
+  rolls + selects), used on CPU (tests, virtual-mesh dryruns) and as
+  the reference implementation the kernel is tested against.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+__all__ = ["route_concentrate", "route_pair", "stack_cols", "unstack_cols"]
+
+
+def _prefix_inclusive(x: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive prefix sum along the last axis of a [1, K] int32 array
+    via log-step shifts (Pallas TPU has no cumsum primitive)."""
+    k = x.shape[-1]
+    sh = 1
+    while sh < k:
+        x = x + jnp.concatenate(
+            [jnp.zeros(x.shape[:-1] + (sh,), x.dtype), x[..., :-sh]],
+            axis=-1)
+        sh *= 2
+    return x
+
+
+def _roll_last(x: jnp.ndarray, m: int) -> jnp.ndarray:
+    """Circular roll by +m along the last axis (out[i] = x[i - m])."""
+    return jnp.concatenate([x[..., -m:], x[..., :-m]], axis=-1)
+
+
+def _route_stages(dst: jnp.ndarray, A: jnp.ndarray, k: int):
+    """Shared stage loop: route (dst, A) through the LSB-first butterfly.
+
+    dst: [1, K] int32 destinations (-1 = don't care); A: [NC, K]."""
+    iota = lax.broadcasted_iota(jnp.int32, (1, k), 1)
+    s = 1
+    while s < k:
+        m = s
+        hib = (iota & m) != 0
+        dp = jnp.where(hib, _roll_last(dst, m), _roll_last(dst, -m))
+        swap = (((dst >= 0) & (((dst & m) != 0) != hib))
+                | ((dp >= 0) & (((dp & m) != 0) == hib)))
+        Ap = jnp.where(hib, _roll_last(A, m), _roll_last(A, -m))
+        A = jnp.where(swap, Ap, A)
+        dst = jnp.where(swap, dp, dst)
+        s *= 2
+    return A
+
+
+def _route_pair_kernel(a_ref, marks_ref, l_ref, r_ref):
+    A = a_ref[...]
+    k = A.shape[-1]
+    ml = marks_ref[0:1, :]
+    mr = marks_ref[1:2, :]
+    pfl = _prefix_inclusive(ml)
+    pfr = _prefix_inclusive(mr)
+    rc = pfr[:, -1:]                                   # [1, 1] total rights
+    dst_l = jnp.where(ml != 0, pfl - 1, -1)
+    dst_r = jnp.where(mr != 0, (k - rc) + pfr - 1, -1)
+    l_ref[...] = _route_stages(dst_l, A, k)
+    r_ref[...] = _route_stages(dst_r, A, k)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def route_pair(A: jnp.ndarray, mark_left: jnp.ndarray,
+               mark_right: jnp.ndarray, interpret: bool = False):
+    """Both concentration passes in one Pallas kernel.
+
+    Args:
+      A: ``[NC, K]`` int32 stacked row columns (K a power of two).
+      mark_left / mark_right: ``[K]`` bool, disjoint row classes
+        (unmarked rows are padding don't-cares).
+    Returns:
+      ``(L, R)``: lefts stably compacted to ``[0, n_left)`` of L,
+      rights to ``[K - n_right, K)`` of R.
+    """
+    nc, k = A.shape
+    marks = jnp.stack([mark_left.astype(jnp.int32),
+                       mark_right.astype(jnp.int32)])
+    out = jax.ShapeDtypeStruct((nc, k), A.dtype)
+    return pl.pallas_call(
+        _route_pair_kernel,
+        out_shape=(out, out),
+        interpret=interpret,
+    )(A, marks)
+
+
+def route_concentrate(cols, mark, offset):
+    """XLA reference implementation: stable compaction of the
+    ``mark``ed rows to positions [offset, offset + popcount(mark)),
+    unmarked rows being don't-cares (see module docstring).
+
+    Args:
+      cols: tuple of ``[K]`` arrays to move (any dtypes, K power of 2).
+      mark: ``[K]`` bool; offset: scalar int32 first destination slot.
+    Returns:
+      tuple of routed ``[K]`` arrays.
+    """
+    stacked, spec = stack_cols(cols)
+    k = stacked.shape[-1]
+    rank = jnp.cumsum(mark.astype(jnp.int32)) - 1
+    dst = jnp.where(mark, offset + rank, -1)[None, :]
+    routed = _route_stages(dst, stacked, k)
+    return unstack_cols(routed, spec)
+
+
+def stack_cols(cols):
+    """Bitcast a tuple of [K] columns (u8/u16/u32/i32/f32) into one
+    [NC, K] int32 matrix + a spec to undo it."""
+    rows, spec = [], []
+    for c in cols:
+        if c.dtype == jnp.int32:
+            rows.append(c)
+        elif c.dtype in (jnp.uint32, jnp.float32):
+            rows.append(lax.bitcast_convert_type(c, jnp.int32))
+        else:
+            rows.append(c.astype(jnp.int32))
+        spec.append(c.dtype)
+    return jnp.stack(rows), tuple(spec)
+
+
+def unstack_cols(A, spec):
+    out = []
+    for i, dt in enumerate(spec):
+        c = A[i]
+        if dt == jnp.int32:
+            out.append(c)
+        elif dt in (jnp.uint32, jnp.float32):
+            out.append(lax.bitcast_convert_type(c, dt))
+        else:
+            out.append(c.astype(dt))
+    return tuple(out)

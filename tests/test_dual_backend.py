@@ -13,9 +13,6 @@ import sys
 import numpy as np
 import pytest
 
-import lightgbm_tpu as lgb
-from conftest import make_synthetic_binary
-
 pytestmark = pytest.mark.skipif(
     os.environ.get("LIGHTGBM_TPU_TEST_DUAL", "") != "1",
     reason="set LIGHTGBM_TPU_TEST_DUAL=1 (needs an accelerator)")

@@ -140,7 +140,7 @@ def test_hist_from_rows_int_exact():
     """int8 nibble histogram is exact integer arithmetic."""
     from lightgbm_tpu.ops.histogram import hist_from_rows_int
     rs = np.random.RandomState(5)
-    S, F, B = 9000, 5, 130  # crosses ROW_BLOCK, s_hi=9
+    S, F, B = 20000, 5, 130  # crosses ROW_BLOCK=16384, s_hi=9
     rows = rs.randint(0, B, size=(S, F)).astype(np.uint8)
     pay = rs.randint(-4, 5, size=(S, 3)).astype(np.int8)
     out = np.asarray(hist_from_rows_int(jnp.asarray(rows),

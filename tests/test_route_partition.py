@@ -1,0 +1,105 @@
+"""Butterfly-route partition (ops/grow.py route_concentrate).
+
+The compact grower's in-chunk stable partition ships as LSB-first
+butterfly concentration routing (GrowConfig.partition="route"); the
+variadic-sort path remains as "sort". These tests pin:
+- the routing primitive against a host-side stable compaction, across
+  exhaustive small chunks and randomized large ones (the
+  congestion-freedom of order-preserving partial routes is a theorem,
+  but the implementation's bit plumbing is what can rot);
+- tree-for-tree equality of the two partition modes through the full
+  grower, the same equivalence bar tests/test_grower_equivalence.py
+  holds the masked/compact pair to.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lightgbm_tpu.ops.grow import GrowConfig, grow_tree, route_concentrate
+from lightgbm_tpu.ops.split import SplitParams
+
+
+def _host_route(mark, col, offset):
+    out = np.full(col.shape, -1, col.dtype)
+    out[offset:offset + mark.sum()] = col[mark]
+    return out
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_route_concentrate_exhaustive_small(k):
+    f = jax.jit(route_concentrate)
+    for bits in range(2 ** k):
+        mark = np.array([(bits >> i) & 1 for i in range(k)], bool)
+        cnt = int(mark.sum())
+        for offset in (0, (k - cnt) // 2, k - cnt):
+            col = np.arange(k, dtype=np.int32)
+            (out,) = f((jnp.asarray(col),), jnp.asarray(mark),
+                       jnp.int32(offset))
+            got = np.asarray(out)[offset:offset + cnt]
+            want = col[mark]
+            assert np.array_equal(got, want), (k, bits, offset)
+
+
+def test_route_concentrate_randomized_large():
+    rs = np.random.RandomState(7)
+    for _ in range(40):
+        k = 2 ** rs.randint(5, 13)
+        mark = rs.rand(k) < rs.rand()
+        cnt = int(mark.sum())
+        offset = int(rs.randint(0, k - cnt + 1))
+        cols = (np.arange(k, dtype=np.int32),
+                rs.randint(0, 2 ** 31, size=k).astype(np.uint32),
+                rs.randn(k).astype(np.float32))
+        outs = route_concentrate(tuple(jnp.asarray(c) for c in cols),
+                                 jnp.asarray(mark), jnp.int32(offset))
+        sel = slice(offset, offset + cnt)
+        for c, o in zip(cols, outs):
+            assert np.array_equal(np.asarray(o)[sel], c[mark])
+
+
+def _grow(partition, bins_T, grad, hess, num_leaves=31, chunk=512,
+          quantized=False):
+    F = bins_T.shape[0]
+    cfg = GrowConfig(num_leaves=num_leaves, num_bins=64,
+                     split=SplitParams(), hist_method="scatter",
+                     grower="compact", chunk=chunk, partition=partition,
+                     quantized=quantized)
+    n = bins_T.shape[1]
+    return grow_tree(cfg, bins_T, grad, hess,
+                     jnp.ones((n,), jnp.float32),
+                     jnp.ones((F,), bool),
+                     jnp.full((F,), 64, jnp.int32),
+                     jnp.full((F,), -1, jnp.int32),
+                     quant_key=(jax.random.PRNGKey(3) if quantized
+                                else None))
+
+
+@pytest.mark.parametrize("n,chunk", [(1000, 512), (4096, 512),
+                                     (777, 256), (513, 1024)])
+def test_grower_route_equals_sort(n, chunk):
+    rs = np.random.RandomState(0)
+    F = 9
+    bins_T = jnp.asarray(rs.randint(0, 64, size=(F, n), dtype=np.uint8))
+    grad = jnp.asarray(rs.randn(n).astype(np.float32))
+    hess = jnp.asarray((np.abs(rs.randn(n)) + 0.1).astype(np.float32))
+    t_r, rl_r = _grow("route", bins_T, grad, hess, chunk=chunk)
+    t_s, rl_s = _grow("sort", bins_T, grad, hess, chunk=chunk)
+    assert np.array_equal(np.asarray(rl_r), np.asarray(rl_s))
+    for a, b in zip(t_r, t_s):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_grower_route_equals_sort_quantized():
+    rs = np.random.RandomState(1)
+    F, n = 6, 2000
+    bins_T = jnp.asarray(rs.randint(0, 64, size=(F, n), dtype=np.uint8))
+    grad = jnp.asarray(rs.randn(n).astype(np.float32))
+    hess = jnp.asarray((np.abs(rs.randn(n)) + 0.1).astype(np.float32))
+    t_r, rl_r = _grow("route", bins_T, grad, hess, quantized=True)
+    t_s, rl_s = _grow("sort", bins_T, grad, hess, quantized=True)
+    assert np.array_equal(np.asarray(rl_r), np.asarray(rl_s))
+    for a, b in zip(t_r, t_s):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
