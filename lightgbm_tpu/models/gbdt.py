@@ -258,6 +258,21 @@ class GBDTBooster:
         self.bins_T = jnp.asarray(self.bundle.bins_bundled.T) \
             if self.bundle is not None else ds.device_bins()
 
+        # -- histogram cache budget (HistogramPool analog;
+        # histogram_pool_size in MB, -1 = unlimited like the reference,
+        # config.h:301). Slots sized by the post-bundle column count;
+        # incompatible features keep the full cache. --
+        if cfg.histogram_pool_size > 0 and grower == "compact" \
+                and not self.cegb_enabled and self.forced is None \
+                and cfg.monotone_constraints_method != "intermediate":
+            ncols = int(self.bins_T.shape[0])
+            per_leaf = ncols * self.grow_cfg.num_bins * 2 * 4
+            slots = int(cfg.histogram_pool_size * 2 ** 20 // per_leaf)
+            slots = max(2, slots)
+            if slots < cfg.num_leaves:
+                self.grow_cfg = self.grow_cfg._replace(
+                    hist_pool_slots=slots)
+
         # -- distributed setup: mesh instead of Network::Init ------------
         # (SURVEY.md §2.6: the socket/MPI linker layer disappears; rows
         # are sharded over a jax Mesh and XLA emits the collectives)

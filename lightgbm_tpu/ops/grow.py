@@ -108,6 +108,16 @@ class GrowConfig(NamedTuple):
     # Exclusive Feature Bundling (ops/bundling.py): bins_T holds bundle
     # columns and the split search runs in bundle-position space
     bundled: bool = False
+    # histogram cache budget (HistogramPool, the reference's
+    # histogram_pool_size: src/treelearner/serial_tree_learner.cpp
+    # GetShareStates + feature_histogram.hpp HistogramPool): 0 keeps
+    # the full [L, F, B, 2] per-leaf cache HBM-resident; a positive
+    # value caps the cache at that many leaf slots — evicted leaves'
+    # histograms are recomputed from their (physically contiguous)
+    # row window on demand. Incompatible with the stored-candidate
+    # re-search paths (CEGB, intermediate monotone, forced splits);
+    # gbdt.py gates those combinations.
+    hist_pool_slots: int = 0
     # in-chunk stable partition primitive (compact grower):
     # "sort"  — one variadic lax.sort on a (side, position) key.
     #           Default: XLA:TPU's variadic sort measures ~35us per
@@ -467,8 +477,11 @@ def _grow_masked_impl(cfg: GrowConfig,
 class _CompactState(NamedTuple):
     tree: TreeArrays
     best: _BestSplits
-    hists: jnp.ndarray       # [L, F, B, 2] (sum_grad, sum_hess)
-    bins2: jnp.ndarray       # [2*(n+2K), F] u8/u16 — two ping-pong
+    hists: jnp.ndarray       # [L, F, B, 2] (sum_grad, sum_hess); when
+                             # the histogram pool is active, [P, F, B,
+                             # 2] slot storage instead (see pool)
+    bins2: jnp.ndarray       # [2*(n+2K), NW] u32 — bin columns packed
+                             # 4 (u8) / 2 (u16) per word; two ping-pong
                              # halves laid out flat; half b's window
                              # positions start at b*(n+2K) + K (K rows
                              # of pad on both sides of each half absorb
@@ -493,6 +506,11 @@ class _CompactState(NamedTuple):
                              # 1=left subtree, 2=right) for intermediate
     node_masks: tuple = ()   # ([L, F] bool,) — per-node sampled feature
                              # sets when cfg.bynode < 1
+    pool: tuple = ()         # histogram pool bookkeeping when
+                             # cfg.hist_pool_slots > 0:
+                             # (leaf2slot [L] i32, -1 = evicted;
+                             #  slot2leaf [P] i32, -1 = free;
+                             #  lru [P] i32 last-use split tick)
 
 
 _IB_BIT = jnp.uint32(1 << 31)
@@ -841,15 +859,6 @@ def _grow_compact_impl(cfg: GrowConfig,
             gl = jnp.where(isc, cm_col, gl)
         return gl
 
-    def _pack_bins(blk_b):
-        """[K, F] u8/u16 -> NW u32 columns (bitcast along the contiguous
-        minor axis; no strided column extraction)."""
-        if Fp != F:
-            blk_b = jnp.pad(blk_b, ((0, 0), (0, Fp - F)))
-        w32 = lax.bitcast_convert_type(blk_b.reshape(K, NW, pack_w),
-                                       jnp.uint32)
-        return tuple(w32[:, i] for i in range(NW))
-
     def _unpack_bins(cols):
         w32 = jnp.stack(cols, axis=1)                     # [K, NW]
         u = lax.bitcast_convert_type(w32, bin_dt)         # [K, NW, pack_w]
@@ -959,7 +968,8 @@ def _grow_compact_impl(cfg: GrowConfig,
             (bins2, pay2, ord2, lazy_used, hist, nu,
              l_off, r_off, nlib, nib) = carry
             pos0 = src_base + c * K
-            blk_b = lax.dynamic_slice(bins2, (pos0, 0), (K, F))
+            blk_w = lax.dynamic_slice(bins2, (pos0, 0), (K, NW))
+            blk_b = _unpack_bins(tuple(blk_w[:, i] for i in range(NW)))
             blk_p = lax.dynamic_slice(pay2, (pos0, 0), (K, C))
             blk_o = lax.dynamic_slice(ord2, (pos0,), (K,))
             blk_i = (blk_o & _IB_BIT) != 0
@@ -989,7 +999,13 @@ def _grow_compact_impl(cfg: GrowConfig,
                 # the leaf (UpdateLeafBestSplits' InsertBitset loop
                 # over the bagged partition)
                 lazy_used = lazy_used.at[rows, f].max(valid & blk_i)
-            cols = _pack_bins(blk_b) + _pack_pay(blk_p) + (blk_o,)
+            # the sort/route move the PACKED u32 word columns; children
+            # are written back packed too — bins only ever unpack
+            # transiently for goleft/histogram (bins2 stays u32-tiled,
+            # avoiding the u8 (4,1) sub-byte layout tax on every
+            # slice/RMW write)
+            cols = tuple(blk_w[:, i] for i in range(NW)) \
+                + _pack_pay(blk_p) + (blk_o,)
             ml = iota_k < l_c
             o_r = dst_base + cnt - r_off - K
             mr = iota_k >= (K - r_c)
@@ -999,10 +1015,10 @@ def _grow_compact_impl(cfg: GrowConfig,
                 # rotate needed — the offset is part of the route).
                 lops = route_concentrate(cols, vl, jnp.int32(0))
                 rops = route_concentrate(cols, valid & ~gl, K - r_c)
-                lb = _unpack_bins(lops[:NW])
+                lb = jnp.stack(lops[:NW], axis=1)
                 lp = _unpack_pay(lops[NW:NW + NPAY])
                 lo = lops[NW + NPAY]
-                rb = _unpack_bins(rops[:NW])
+                rb = jnp.stack(rops[:NW], axis=1)
                 rp = _unpack_pay(rops[NW:NW + NPAY])
                 ro = rops[NW + NPAY]
             else:
@@ -1011,7 +1027,7 @@ def _grow_compact_impl(cfg: GrowConfig,
                 side = jnp.where(vl, 0, jnp.where(valid, 1, 2))
                 key = side * K + iota_k
                 ops = lax.sort((key,) + cols, num_keys=1)
-                lb = _unpack_bins(ops[1:1 + NW])
+                lb = jnp.stack(ops[1:1 + NW], axis=1)
                 lp = _unpack_pay(ops[1 + NW:1 + NW + NPAY])
                 lo = ops[1 + NW + NPAY]
                 # rights [l_c, l_c+r_c) rotated to the block END
@@ -1041,6 +1057,30 @@ def _grow_compact_impl(cfg: GrowConfig,
         nr_ex = psum(n_ib - n_left_ib).astype(dtype)
         return (bins2, pay2, ord2, lazy_used, n_left, nl_ex, nr_ex,
                 hist_psum(est_hist), est_nu)
+
+    def window_hist(bins2, pay2, src, start, cnt):
+        """Recompute one leaf's full histogram from its contiguous row
+        window — the pool-miss path (the reference recomputes evicted
+        histograms the same way, HistogramPool::Get on a miss).
+        Out-of-bag rows carry zero payload (w folded into pay2), so no
+        extra masking beyond the window tail is needed."""
+        src_base = src * SEG + K + start
+        acc0 = jnp.zeros((F, B, C), jnp.int32 if quant else dtype)
+
+        def body(c, acc):
+            pos0 = src_base + c * K
+            blk_w = lax.dynamic_slice(bins2, (pos0, 0), (K, NW))
+            blk_b = _unpack_bins(tuple(blk_w[:, i] for i in range(NW)))
+            blk_p = lax.dynamic_slice(pay2, (pos0, 0), (K, C))
+            valid = iota_k < jnp.clip(cnt - c * K, 0, K)
+            hp = blk_p * valid[:, None].astype(blk_p.dtype)
+            if quant:
+                return acc + hist_from_rows_int(blk_b, hp, B, hmethod)
+            return acc + hist_from_rows(blk_b, hp, B, hmethod,
+                                        cfg.hist_precision)
+
+        return hist_psum(lax.fori_loop(0, window_chunks(cnt), body,
+                                       acc0))
 
     # ---- root ----
     total_c = psum(jnp.sum(inbag.astype(dtype)))
@@ -1106,15 +1146,39 @@ def _grow_compact_impl(cfg: GrowConfig,
                                   root_out, jnp.asarray(0, jnp.int32),
                                   root_bounds),
                       jnp.asarray(True))
-    hists = jnp.zeros((L, F, B, 2),
+    # histogram cache: full per-leaf [L, F, B, 2], or a bounded slot
+    # pool [PS, F, B, 2] with recompute-on-miss (HistogramPool analog,
+    # feature_histogram.hpp; budget from histogram_pool_size)
+    pooled = 0 < cfg.hist_pool_slots < L
+    PS = cfg.hist_pool_slots if pooled else L
+    if pooled and (cegb or intermediate or forced is not None):
+        raise NotImplementedError(
+            "hist_pool_slots is incompatible with CEGB / intermediate "
+            "monotone / forced splits (their re-search walks every "
+            "leaf's cached histogram); gbdt.py gates these")
+    hists = jnp.zeros((PS, F, B, 2),
                       jnp.int32 if quant else dtype).at[0].set(root_hist)
+    pool_state = ()
+    if pooled:
+        pool_state = (
+            jnp.full((L,), -1, jnp.int32).at[0].set(0),   # leaf2slot
+            jnp.full((PS,), -1, jnp.int32).at[0].set(0),  # slot2leaf
+            jnp.zeros((PS,), jnp.int32),                  # lru tick
+        )
     pay0 = gw2_q if quant \
         else (gw2.astype(jnp.bfloat16) if bf16_pay else gw2)
+    # the streamed copy of the bin matrix lives PACKED: u32 words of
+    # pack_w bin columns each (u8 arrays carry a (4,1) sub-byte tiling
+    # that taxes every dynamic slice / masked RMW ~2-4x)
+    bins_pk = bins_rm if Fp == F \
+        else jnp.pad(bins_rm, ((0, 0), (0, Fp - F)))
+    bins_pk = lax.bitcast_convert_type(
+        bins_pk.reshape(n, NW, pack_w), jnp.uint32)        # [n, NW]
     ord0 = jnp.arange(n, dtype=jnp.uint32) \
         | jnp.where(inbag, _IB_BIT, jnp.uint32(0))
     state = _CompactState(
         tree=tree, best=best, hists=hists,
-        bins2=jnp.pad(bins_rm, ((K, K + SEG), (0, 0))),
+        bins2=jnp.pad(bins_pk, ((K, K + SEG), (0, 0))),
         pay2=jnp.pad(pay0, ((K, K + SEG), (0, 0))),
         ord2=jnp.pad(ord0, (K, K + SEG)),
         leaf_buf=jnp.zeros((L,), jnp.int32),
@@ -1122,7 +1186,8 @@ def _grow_compact_impl(cfg: GrowConfig,
         leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(n),
         branch=jnp.zeros((L, F), jnp.bool_),
         num_splits=jnp.asarray(0, jnp.int32),
-        cegb=cegb_state, mono=mono_state, node_masks=nmask_state)
+        cegb=cegb_state, mono=mono_state, node_masks=nmask_state,
+        pool=pool_state)
 
     def depth_ok(d):
         if cfg.max_depth <= 0:
@@ -1178,7 +1243,8 @@ def _grow_compact_impl(cfg: GrowConfig,
     def do_split(state: _CompactState,
                  leaf_override=None) -> _CompactState:
         (tree, best, hists, bins2, pay2, ord2, leaf_buf,
-         lbegin, lcount, branch, ns, cegb_st, mono_st, nmask_st) = state
+         lbegin, lcount, branch, ns, cegb_st, mono_st, nmask_st,
+         pool_st) = state
         leaf = jnp.argmax(best.gain).astype(jnp.int32) \
             if leaf_override is None else leaf_override
         R = ns + 1
@@ -1192,6 +1258,20 @@ def _grow_compact_impl(cfg: GrowConfig,
         cm = best.cat_mask[leaf]
         est_left_small = best.left_count[leaf] <= best.right_count[leaf]
         lazy_arr = cegb_st[1] if cegb else jnp.zeros((1, 1), jnp.bool_)
+
+        # parent histogram BEFORE the partition reorders the window:
+        # from the cache (full mode / pool hit) or recomputed from the
+        # still-contiguous parent window (pool miss)
+        if pooled:
+            leaf2slot, slot2leaf, lru = pool_st
+            slot_l = leaf2slot[leaf]
+            parent_hist = lax.cond(
+                slot_l >= 0,
+                lambda: lax.dynamic_index_in_dim(
+                    hists, jnp.maximum(slot_l, 0), keepdims=False),
+                lambda: window_hist(bins2, pay2, src, start, cnt))
+        else:
+            parent_hist = hists[leaf]
 
         # -- partition the leaf's range (DataPartition::Split analog) +
         # child histogram, fused into one streaming pass --
@@ -1209,11 +1289,41 @@ def _grow_compact_impl(cfg: GrowConfig,
         tree = _apply_split_to_tree(tree, best, leaf, R, ns, p,
                                     nl_ex, nr_ex)
 
-        parent_hist = hists[leaf]
         other_hist = subtract_histogram(parent_hist, est_hist)
         left_hist = jnp.where(est_left_small, est_hist, other_hist)
         right_hist = jnp.where(est_left_small, other_hist, est_hist)
-        hists = hists.at[leaf].set(left_hist).at[R].set(right_hist)
+        if pooled:
+            # store the children: the left child inherits the parent's
+            # slot when cached; otherwise (and for the right child) the
+            # least-recently-used slot is evicted (HistogramPool LRU)
+            tick = R
+
+            def alloc(leaf2slot, slot2leaf, lru, forbid, take):
+                """Pick the LRU victim slot (skipping ``forbid``) and —
+                only when ``take`` — unmap its previous leaf."""
+                score = jnp.where(jnp.arange(PS) == forbid,
+                                  jnp.int32(2 ** 30), lru)
+                victim = jnp.argmin(score).astype(jnp.int32)
+                old = slot2leaf[victim]
+                oldc = jnp.clip(old, 0, L - 1)
+                leaf2slot = leaf2slot.at[oldc].set(
+                    jnp.where(take & (old >= 0), -1, leaf2slot[oldc]))
+                return leaf2slot, victim
+
+            leaf2slot, victim1 = alloc(leaf2slot, slot2leaf, lru,
+                                       jnp.int32(-2), slot_l < 0)
+            s_l = jnp.where(slot_l >= 0, slot_l, victim1)
+            slot2leaf = slot2leaf.at[s_l].set(leaf)
+            lru = lru.at[s_l].set(tick)
+            leaf2slot, s_r = alloc(leaf2slot, slot2leaf, lru, s_l,
+                                   jnp.asarray(True))
+            slot2leaf = slot2leaf.at[s_r].set(R)
+            lru = lru.at[s_r].set(tick)
+            leaf2slot = leaf2slot.at[leaf].set(s_l).at[R].set(s_r)
+            hists = hists.at[s_l].set(left_hist).at[s_r].set(right_hist)
+            pool_st = (leaf2slot, slot2leaf, lru)
+        else:
+            hists = hists.at[leaf].set(left_hist).at[R].set(right_hist)
 
         # -- monotone output-bound entries (BasicLeafConstraints::Update /
         # IntermediateLeafConstraints::UpdateConstraintsWithOutputs) --
@@ -1365,7 +1475,7 @@ def _grow_compact_impl(cfg: GrowConfig,
                              leaf_begin=lbegin, leaf_count=lcount,
                              branch=branch, num_splits=ns + 1,
                              cegb=cegb_st, mono=mono_st,
-                             node_masks=nmask_st)
+                             node_masks=nmask_st, pool=pool_st)
 
     def forced_result(hist, tc, f, t, p_out, bnds) -> SplitResult:
         """Fixed (feature, bin) split record from a leaf's histogram

@@ -60,22 +60,68 @@ def sync_bin_mappers(mappers: List) -> List:
 
 def distributed_dataset(X, label=None, params: Optional[dict] = None,
                         **kwargs):
-    """Build a Dataset from THIS process's row shard with bin
-    boundaries synchronized across all processes (rank-strided loading
-    + mapper sync, the LoadFromFile(rank, num_machines) analog)."""
+    """Build the GLOBAL training Dataset from THIS process's row shard.
+
+    Protocol (``pre_partition=false`` distributed loading,
+    dataset_loader.cpp: every machine ends up binning against identical
+    boundaries and the partition happens at the device level):
+    1. bin the local shard, 2. broadcast process 0's BinMappers and
+    re-bin against them (``sync_bin_mappers``), 3. allgather the BINNED
+    u8/u16 shards + metadata so every process holds the identical
+    global Dataset. Host RAM holds the full binned matrix (1-2 bytes
+    per value); device HBM only ever receives each device's row shard
+    — the mesh-parallel learner's input sharding does the partition.
+    Every process then trains the identical replicated model — there
+    is no "keep worker 0's result" step.
+
+    Shards must have equal row counts across processes (pad the last
+    shard if needed; padded rows can carry weight 0). For ranking,
+    each shard must contain whole query groups.
+    """
     from ..basic import Dataset
 
     ds = Dataset(X, label=label, params=params, **kwargs)
     ds.construct()
-    ds.mappers = sync_bin_mappers(ds.mappers)
-    # re-bin the local rows against the synchronized boundaries
     import jax
 
-    if jax.process_count() > 1:
-        from ..ops.binning import bin_values
+    if jax.process_count() <= 1:
+        return ds
+    from jax.experimental import multihost_utils
 
-        Xf = np.asarray(X, np.float64)
-        cols = [Xf[:, j] for j in ds._used_features]
-        ds._bins = bin_values(cols, ds.mappers)
-        ds._device_bins = None
+    from ..ops.binning import bin_values
+
+    ds.mappers = sync_bin_mappers(ds.mappers)
+    # re-bin the local rows against the synchronized boundaries
+    Xf = np.asarray(X, np.float64)
+    cols = [Xf[:, j] for j in ds._used_features]
+    local_bins = bin_values(cols, ds.mappers)
+
+    def gather_rows(a, dtype):
+        if a is None:
+            return None
+        a = np.asarray(a, dtype)
+        g = multihost_utils.process_allgather(a)   # [P, n_local, ...]
+        return np.concatenate(list(g), axis=0)
+
+    ds._bins = gather_rows(local_bins, local_bins.dtype)
+    ds._device_bins = None
+    ds._n = ds._bins.shape[0]
+    ds.label = gather_rows(ds.label, np.float64)
+    ds.weight = gather_rows(ds.weight, np.float64)
+    ds.init_score = gather_rows(ds.init_score, np.float64)
+    ds.position = gather_rows(ds.position, np.int32)
+    if ds.group is not None:
+        g = multihost_utils.process_allgather(
+            np.asarray(ds.group, np.int32))
+        ds.group = np.concatenate(list(g), axis=0)
+        # rebuild the query boundaries for the GLOBAL row set (the
+        # shard-local ones from construct() cover only n_local rows)
+        ds._query_boundaries = np.concatenate(
+            [[0], np.cumsum(np.asarray(ds.group, np.int64))])
+    # the raw feature matrix still holds only the local shard; drop it
+    # so num_data()/get_data() stay consistent (raw-data consumers —
+    # linear_tree, refit — raise their usual "raw data not retained"
+    # errors instead of silently pairing half a matrix with global
+    # labels)
+    ds.data = None
     return ds

@@ -1,0 +1,57 @@
+"""Worker for the real 2-process SPMD test (test_multiprocess.py).
+
+Each process owns 2 virtual CPU devices and one contiguous row shard;
+together they form a 4-device global mesh — the same topology as two
+single-chip hosts on DCN. Run as:
+
+    python spmd_worker.py <rank> <coordinator_port> <outdir>
+"""
+
+import os
+import sys
+
+rank = int(sys.argv[1])
+port = sys.argv[2]
+outdir = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from lightgbm_tpu.parallel.distributed import init_distributed  # noqa: E402
+
+init_distributed(coordinator_address=f"127.0.0.1:{port}",
+                 num_processes=2, process_id=rank)
+assert jax.process_count() == 2
+assert len(jax.devices()) == 4
+
+import numpy as np  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.parallel import spmd  # noqa: E402
+
+rs = np.random.RandomState(0)
+n, f = 2000, 6
+X = rs.randn(n, f)
+y = ((X[:, 0] + 0.5 * X[:, 1] - 0.2 * X[:, 2]
+      + 0.1 * rs.randn(n)) > 0).astype(float)
+half = n // 2
+lo, hi = rank * half, (rank + 1) * half
+
+ds = spmd.distributed_dataset(X[lo:hi], label=y[lo:hi],
+                              params={"verbosity": -1})
+bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                 "min_data_in_leaf": 5, "tree_learner": "data",
+                 "verbosity": -1}, ds, num_boost_round=5)
+
+# every process computes the identical replicated model; process 0
+# writes it (the Dask layer's "keep worker 0's model",
+# python-package/lightgbm/dask.py:_train_part)
+if rank == 0:
+    bst.save_model(os.path.join(outdir, "model_mp.txt"))
+print(f"rank {rank} DONE", flush=True)

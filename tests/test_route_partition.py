@@ -60,6 +60,38 @@ def test_route_concentrate_randomized_large():
             assert np.array_equal(np.asarray(o)[sel], c[mark])
 
 
+def test_route_pair_kernel_matches_xla_route():
+    """The Pallas pair kernel (ops/partition_kernel.py route_pair) in
+    interpret mode against the XLA route — the oracle relationship the
+    module docstring promises. (On-TPU the kernel is currently slower
+    than the in-situ sort and unused; see benchmarks/PROFILE.md.)"""
+    from lightgbm_tpu.ops.partition_kernel import (route_pair,
+                                                   stack_cols,
+                                                   unstack_cols)
+    rs = np.random.RandomState(11)
+    for k in (256, 1024):
+        cols = (jnp.asarray(rs.randint(0, 2 ** 31, size=k)
+                            .astype(np.uint32)),
+                jnp.asarray(rs.randn(k).astype(np.float32)))
+        r = rs.rand(k)
+        vl = jnp.asarray(r < 0.35)
+        vr = jnp.asarray((r >= 0.35) & (r < 0.9))
+        rc = int(np.sum((r >= 0.35) & (r < 0.9)))
+        lc = int(np.sum(r < 0.35))
+        A, spec = stack_cols(cols)
+        L, R = route_pair(A, vl, vr, interpret=True)
+        lops = unstack_cols(L, spec)
+        rops = unstack_cols(R, spec)
+        l_ref = route_concentrate(cols, vl, jnp.int32(0))
+        r_ref = route_concentrate(cols, vr, jnp.int32(k - rc))
+        for a, b in zip(lops, l_ref):
+            assert np.array_equal(np.asarray(a)[:lc],
+                                  np.asarray(b)[:lc])
+        for a, b in zip(rops, r_ref):
+            assert np.array_equal(np.asarray(a)[k - rc:],
+                                  np.asarray(b)[k - rc:])
+
+
 def _grow(partition, bins_T, grad, hess, num_leaves=31, chunk=512,
           quantized=False):
     F = bins_T.shape[0]
