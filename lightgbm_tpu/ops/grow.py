@@ -911,6 +911,23 @@ def _grow_compact_impl(cfg: GrowConfig,
 
     SEG = n + 2 * K  # rows per ping-pong half (K pad on both sides)
 
+    def chunk_hist(bins2, pay2, base, c, limit):
+        """Histogram of one K-row chunk at dynamic row offset
+        ``base + c*K``: slice the packed bin words + payload, mask the
+        window tail (rows past ``limit``), accumulate on the MXU.
+        Shared by the post-partition child pass and the pool-miss
+        window recompute."""
+        pos0 = base + c * K
+        blk_w = lax.dynamic_slice(bins2, (pos0, 0), (K, NW))
+        blk_b = _unpack_bins(tuple(blk_w[:, i] for i in range(NW)))
+        blk_p = lax.dynamic_slice(pay2, (pos0, 0), (K, C))
+        valid = iota_k < jnp.clip(limit - c * K, 0, K)
+        hp = blk_p * valid[:, None].astype(blk_p.dtype)
+        if quant:
+            return hist_from_rows_int(blk_b, hp, B, hmethod), valid
+        return hist_from_rows(blk_b, hp, B, hmethod,
+                              cfg.hist_precision), valid
+
     def part_apply(bins2, pay2, ord2, lazy_used, src, start, cnt,
                    f, t, dl, isc, cm, est_left_small):
         """Stable two-way window compaction + child histogram in ONE
@@ -935,12 +952,14 @@ def _grow_compact_impl(cfg: GrowConfig,
         a NEIGHBORING leaf's live rows whenever cnt is not K-aligned.
         The left child therefore stays in the parent's half and the
         right child lands in the opposite half (leaf_buf tracks this).
-        The histogram of the (estimated-)smaller child is accumulated
-        from the same resident chunk before the sort — the sibling
-        follows by subtraction. The CUDA analog is
-        GenDataToLeftBitVector + prefix-sum compaction
-        (cuda_data_partition.cu) + ConstructHistogramForLeaf
-        (cuda_histogram_constructor.cu), fused into one data movement.
+        The histogram of the (estimated-)smaller child is then built
+        in a SECOND streaming pass over that child's now-contiguous
+        rows only — the sibling follows by subtraction — so histogram
+        work scales with Sum(min-child) instead of Sum(parent) rows.
+        The CUDA analog is GenDataToLeftBitVector + prefix-sum
+        compaction (cuda_data_partition.cu) followed by
+        ConstructHistogramForLeaf on the smaller leaf
+        (cuda_histogram_constructor.cu).
 
         ``est_left_small`` picks the histogrammed side from the stored
         SplitInfo's count estimates — decided before streaming (the
@@ -965,7 +984,7 @@ def _grow_compact_impl(cfg: GrowConfig,
             return lax.dynamic_update_slice(arr, out, (off,))
 
         def body(c, carry):
-            (bins2, pay2, ord2, lazy_used, hist, nu,
+            (bins2, pay2, ord2, lazy_used,
              l_off, r_off, nlib, nib) = carry
             pos0 = src_base + c * K
             blk_w = lax.dynamic_slice(bins2, (pos0, 0), (K, NW))
@@ -980,21 +999,8 @@ def _grow_compact_impl(cfg: GrowConfig,
             r_c = jnp.sum((valid & ~gl).astype(jnp.int32))
             nlib += jnp.sum((vl & blk_i).astype(jnp.int32))
             nib += jnp.sum((valid & blk_i).astype(jnp.int32))
-            # histogram of the estimated-smaller side, from the chunk
-            # already in registers (pre-sort; order is irrelevant)
-            hmask = jnp.where(est_left_small, vl, valid & ~gl)
-            if quant:
-                hp = blk_p * hmask[:, None].astype(jnp.int8)
-                hist = hist + hist_from_rows_int(blk_b, hp, B, hmethod)
-            else:
-                hp = blk_p * hmask[:, None].astype(blk_p.dtype)
-                hist = hist + hist_from_rows(blk_b, hp, B, hmethod,
-                                             cfg.hist_precision)
             if cegb_lazy:
                 rows = (blk_o & ~_IB_BIT).astype(jnp.int32)
-                used_rows = jnp.take(lazy_used, rows, axis=0)   # [K, F]
-                nu = nu + jnp.sum((hmask & blk_i)[:, None] & ~used_rows,
-                                  axis=0).astype(dtype)
                 # the split acquires feature f for every in-bag row in
                 # the leaf (UpdateLeafBestSplits' InsertBitset loop
                 # over the bagged partition)
@@ -1041,14 +1047,49 @@ def _grow_compact_impl(cfg: GrowConfig,
             bins2 = write(bins2, o_r, rb, mr)
             pay2 = write(pay2, o_r, rp, mr)
             ord2 = write(ord2, o_r, ro, mr)
-            return (bins2, pay2, ord2, lazy_used, hist, nu,
+            return (bins2, pay2, ord2, lazy_used,
                     l_off + l_c, r_off + r_c, nlib, nib)
 
-        (bins2, pay2, ord2, lazy_used, est_hist, est_nu, n_left, _,
+        (bins2, pay2, ord2, lazy_used, n_left, _,
          n_left_ib, n_ib) = lax.fori_loop(
             0, window_chunks(cnt), body,
-            (bins2, pay2, ord2, lazy_used, acc0, jnp.zeros((F,), dtype),
-             zero, zero, zero, zero))
+            (bins2, pay2, ord2, lazy_used, zero, zero, zero, zero))
+
+        # -- second streaming pass: histogram of the estimated-smaller
+        # child over its NOW-CONTIGUOUS rows only. Histogram work drops
+        # from Sum(parent) to Sum(min-child) rows per tree (~0.42x
+        # empirically), which the one extra read of the small side's
+        # rows does not come close to cancelling. The side is chosen by
+        # the search-time count ESTIMATES (deterministic, replicated
+        # across shards), like the reference's smaller-leaf choice
+        # (serial_tree_learner.cpp:473-520); the sibling follows by
+        # subtraction. --
+        est_start = jnp.where(est_left_small, start, start + n_left)
+        est_cnt = jnp.where(est_left_small, n_left, cnt - n_left)
+        est_half = jnp.where(est_left_small, src, 1 - src)
+        est_base = est_half * SEG + K + est_start
+
+        def hist_body(c, carry):
+            hist, nu = carry
+            h, valid = chunk_hist(bins2, pay2, est_base, c, est_cnt)
+            hist = hist + h
+            if cegb_lazy:
+                blk_o = lax.dynamic_slice(ord2, (est_base + c * K,),
+                                          (K,))
+                blk_i = (blk_o & _IB_BIT) != 0
+                rows = (blk_o & ~_IB_BIT).astype(jnp.int32)
+                used_rows = jnp.take(lazy_used, rows, axis=0)  # [K, F]
+                # lazy_used already acquired feature f during the
+                # partition pass, so column f over-counts as "used" —
+                # harmless: the caller zeroes est_nu[f] regardless
+                # (do_split's est_nu_z)
+                nu = nu + jnp.sum((valid & blk_i)[:, None] & ~used_rows,
+                                  axis=0).astype(dtype)
+            return hist, nu
+
+        est_hist, est_nu = lax.fori_loop(
+            0, window_chunks(est_cnt), hist_body,
+            (acc0, jnp.zeros((F,), dtype)))
 
         # exact global in-bag child counts replace the search-time
         # hessian-ratio estimates (SplitInner update_cnt,
@@ -1068,16 +1109,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         acc0 = jnp.zeros((F, B, C), jnp.int32 if quant else dtype)
 
         def body(c, acc):
-            pos0 = src_base + c * K
-            blk_w = lax.dynamic_slice(bins2, (pos0, 0), (K, NW))
-            blk_b = _unpack_bins(tuple(blk_w[:, i] for i in range(NW)))
-            blk_p = lax.dynamic_slice(pay2, (pos0, 0), (K, C))
-            valid = iota_k < jnp.clip(cnt - c * K, 0, K)
-            hp = blk_p * valid[:, None].astype(blk_p.dtype)
-            if quant:
-                return acc + hist_from_rows_int(blk_b, hp, B, hmethod)
-            return acc + hist_from_rows(blk_b, hp, B, hmethod,
-                                        cfg.hist_precision)
+            return acc + chunk_hist(bins2, pay2, src_base, c, cnt)[0]
 
         return hist_psum(lax.fori_loop(0, window_chunks(cnt), body,
                                        acc0))
@@ -1375,9 +1407,11 @@ def _grow_compact_impl(cfg: GrowConfig,
             coupled_used, _, lazy_nu = cegb_st
             first_use = ~coupled_used[f_split] & (pen_coupled[f_split] > 0)
             coupled_used = coupled_used | (jnp.arange(F) == f_split)
-            # parent rows acquired f_split during partition; counts for
-            # the children follow by subtraction on the updated parent
-            # (the pass counted f pre-acquisition, so zero it here too)
+            # parent rows acquired f_split during the partition pass
+            # (before the hist/nu pass read lazy_used), so est_nu[f]
+            # is post-acquisition garbage; zero it, and zero the
+            # parent's column too so the children's counts follow by
+            # subtraction on acquisition-consistent vectors
             est_nu_z = est_nu.at[f_split].set(0.0)
             parent_nu = lazy_nu[leaf].at[f_split].set(0.0)
             big_nu = jnp.maximum(parent_nu - est_nu_z, 0.0)

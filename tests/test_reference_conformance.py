@@ -154,3 +154,54 @@ def test_model_txt_loads_and_round_trips(tmp_path):
     p1 = bst.predict(X)
     p2 = lgb.Booster(model_file=str(path)).predict(X)
     np.testing.assert_allclose(p1, p2, rtol=1e-6, atol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# Cross-implementation model exchange: models PRODUCED BY THE REFERENCE
+# CLI (tests/data/README.md documents provenance) must load and predict
+# here. Per-row agreement is f32-boundary-limited: device prediction
+# compares f32 values against f32-rounded thresholds, so rows whose
+# f64 feature value sits between a threshold and its f32 rounding can
+# route differently (6/500 rows on the binary example); everything
+# else matches the reference's own predictions to float precision.
+# ---------------------------------------------------------------------------
+
+_DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+def test_reference_binary_model_cross_loads():
+    bst = lgb.Booster(model_file=os.path.join(_DATA, "binary.model.txt"))
+    assert bst.num_trees() == 100
+    X = np.loadtxt(os.path.join(REF, "binary_classification",
+                                "binary.test"), delimiter="\t")[:, 1:]
+    p = bst.predict(X)
+    ref = np.loadtxt(os.path.join(_DATA, "binary.pred.txt"))
+    d = np.abs(p - ref)
+    assert np.median(d) < 1e-7
+    assert np.mean(d < 1e-6) >= 0.98
+    assert d.max() < 0.05
+    # quality identical on the example's own labels
+    y = np.loadtxt(os.path.join(REF, "binary_classification",
+                                "binary.test"), delimiter="\t")[:, 0]
+    acc_ours = np.mean((p > 0.5) == (y > 0.5))
+    acc_ref = np.mean((ref > 0.5) == (y > 0.5))
+    assert abs(acc_ours - acc_ref) <= 0.004
+
+
+def test_reference_ranker_model_cross_loads():
+    from lightgbm_tpu.basic import _load_text_file
+    from lightgbm_tpu.config import Config
+    bst = lgb.Booster(model_file=os.path.join(_DATA, "rank.model.txt"))
+    assert bst.num_trees() == 100
+    # parse rank.test with OUR LibSVM parser (reference-equivalent
+    # 0-based indexing; sklearn's loader re-bases indices)
+    X, _, _, _ = _load_text_file(os.path.join(REF, "lambdarank",
+                                              "rank.test"), Config())
+    nf = bst.num_feature()
+    if X.shape[1] < nf:
+        X = np.hstack([X, np.zeros((X.shape[0], nf - X.shape[1]))])
+    p = bst.predict(X[:, :nf])
+    ref = np.loadtxt(os.path.join(_DATA, "rank.pred.txt"))
+    d = np.abs(p - ref)
+    assert np.median(d) < 1e-6
+    assert d.max() < 1e-4
