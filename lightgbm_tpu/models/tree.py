@@ -218,6 +218,19 @@ class Tree:
             shrinkage=float(kv.get("shrinkage", "1")),
             is_linear=bool(int(kv.get("is_linear", "0"))),
         )
+        # the batched predictor sweeps nodes in index order and relies
+        # on internal children having LARGER indices than their parent
+        # (ops/predict.py _traverse; Tree::Split numbering guarantees
+        # this for every model LightGBM or this package writes) —
+        # reject third-party model strings that violate it rather than
+        # silently mispredicting
+        for i in range(n_nodes):
+            for c in (int(t.left_child[i]), int(t.right_child[i])):
+                if 0 <= c <= i:
+                    raise ValueError(
+                        f"model tree node {i} has internal child {c} "
+                        "<= its own index; node numbering must be "
+                        "topological (parent before child)")
         if num_cat > 0:
             t.cat_boundaries = np.asarray(kv["cat_boundaries"].split(),
                                           np.int64)

@@ -1,10 +1,13 @@
-"""Batched tree traversal (prediction) as XLA gathers.
+"""Batched tree traversal (prediction) as an in-order node sweep.
 
 Re-design of Tree::Predict / the branchy per-row traversal
 (/root/reference/include/LightGBM/tree.h:134,338-410 and
-src/boosting/gbdt_prediction.cpp) as a vectorized node-pointer iteration:
-every row walks the tree simultaneously via gathers on the flat tree
-tensors; a ``lax.while_loop`` runs until all rows hit a leaf.
+src/boosting/gbdt_prediction.cpp): one ``fori_loop`` over nodes in
+creation order (parents always precede children) decides each node for
+ALL rows at once from the node's scalar attributes, so no [n]-sized
+gathers from node tables ever occur — XLA:TPU serializes those per
+element (benchmarks/PROFILE.md), and the sweep is also ~2.4x faster
+than the gather walk on CPU.
 
 Missing-value routing matches the reference's NumericalDecision
 (tree.h:338-360): missing_type none -> NaN treated as 0; zero -> |v| <=
@@ -52,20 +55,30 @@ class StackedTrees(NamedTuple):
     lin_coef: jnp.ndarray = None    # [T, L, km] f32
 
 
-def _traverse(n: int, decide_fn, left_child, right_child):
-    """Run node-pointer iteration until every row reaches a leaf."""
+def _traverse(n: int, decide_node_fn, left_child, right_child):
+    """Route every row to its leaf by ONE in-order sweep over nodes.
+
+    Internal node k is created by split k, so a node's index is always
+    greater than its parent's (models/tree.py follows the reference's
+    Tree::Split numbering) — processing nodes 0..nn-1 in order
+    therefore visits each row's path nodes in path order, and a single
+    ``fori_loop`` replaces the per-level pointer chase. Crucially,
+    each step uses SCALAR node attributes (``decide_node_fn(i)``
+    evaluates node i's decision for all rows at once), so there are no
+    [n]-sized gathers from node tables — XLA:TPU executes those one
+    element at a time (benchmarks/PROFILE.md), which made the old
+    per-level walk ~1.6 s per million rows; this sweep is pure vector
+    selects.
+    """
+    nn = left_child.shape[0]
     node0 = jnp.zeros((n,), jnp.int32)
 
-    def cond(node):
-        return jnp.any(node >= 0)
+    def body(i, node):
+        go_left = decide_node_fn(i)
+        nxt = jnp.where(go_left, left_child[i], right_child[i])
+        return jnp.where(node == i, nxt, node)
 
-    def body(node):
-        idx = jnp.maximum(node, 0)
-        go_left = decide_fn(idx)
-        nxt = jnp.where(go_left, left_child[idx], right_child[idx])
-        return jnp.where(node >= 0, nxt, node)
-
-    node = lax.while_loop(cond, body, node0)
+    node = lax.fori_loop(0, nn, body, node0)
     return ~node  # leaf indices
 
 
@@ -80,36 +93,36 @@ def predict_leaf_binned(split_feature, threshold_bin, default_left,
     nodes by bin membership instead of the bin threshold.
     """
     n = bins_T.shape[1]
-    rows = jnp.arange(n)
 
-    def decide(idx):
-        sf = split_feature[idx]
-        v = bins_T[sf, rows].astype(jnp.int32)
+    def decide(i):
+        sf = split_feature[i]
+        v = lax.dynamic_index_in_dim(bins_T, sf, keepdims=False) \
+            .astype(jnp.int32)                                # [n]
         nb = feat_nan_bin[sf]
-        num_left = jnp.where((nb >= 0) & (v == nb), default_left[idx],
-                             v <= threshold_bin[idx])
+        num_left = jnp.where((nb >= 0) & (v == nb), default_left[i],
+                             v <= threshold_bin[i])
         if is_cat is None:
             return num_left
-        return jnp.where(is_cat[idx], cat_masks[idx, v], num_left)
+
+        def cat_branch():
+            # bin membership via the node's [B] mask: one-hot compare
+            # (a cat_masks[i, v] gather would serialize per element).
+            # This caller is never vmapped, so lax.cond genuinely
+            # skips the [n, B] pass on numeric nodes
+            B = cat_masks.shape[1]
+            return jnp.any((v[:, None] == jnp.arange(B)[None, :])
+                           & cat_masks[i][None, :], axis=1)
+
+        return lax.cond(is_cat[i], cat_branch, lambda: num_left)
 
     return _traverse(n, decide, left_child, right_child)
-
-
-def _cat_contains(bitset_row: jnp.ndarray, value: jnp.ndarray) -> jnp.ndarray:
-    """Test value membership in a u32 bitset (FindInBitset analog)."""
-    W = bitset_row.shape[-1]
-    word = value // 32
-    bit = value % 32
-    in_range = (value >= 0) & (word < W)
-    w = jnp.take_along_axis(bitset_row, jnp.maximum(word, 0)[..., None],
-                            axis=-1)[..., 0]
-    return in_range & ((w >> bit.astype(jnp.uint32)) & 1).astype(jnp.bool_)
 
 
 def predict_leaf_raw(tree: StackedTrees, ti: int | jnp.ndarray,
                      X: jnp.ndarray) -> jnp.ndarray:
     """Leaf index per row for tree ``ti`` over raw features ``[n, F]``."""
     n = X.shape[0]
+    X_T = X.T  # [F, n]: node sweeps slice whole contiguous columns
     sf = tree.split_feature[ti]
     thr = tree.threshold[ti]
     dl = tree.default_left[ti]
@@ -117,10 +130,9 @@ def predict_leaf_raw(tree: StackedTrees, ti: int | jnp.ndarray,
     is_cat = tree.is_categorical[ti]
     bitset = tree.cat_bitset[ti]
 
-    def decide(idx):
-        f = sf[idx]
-        v = jnp.take_along_axis(X, f[:, None], axis=1)[:, 0]
-        m = mt[idx]
+    def decide(i):
+        v = lax.dynamic_index_in_dim(X_T, sf[i], keepdims=False)  # [n]
+        m = mt[i]
         is_nan = jnp.isnan(v)
         v0 = jnp.where(is_nan, 0.0, v)
         # numerical decision with missing routing (tree.h:338-360)
@@ -128,11 +140,28 @@ def predict_leaf_raw(tree: StackedTrees, ti: int | jnp.ndarray,
         missing = jnp.where(m == MISSING_NAN, is_nan,
                             jnp.where(m == MISSING_ZERO, is_zero | is_nan,
                                       jnp.zeros_like(is_nan)))
-        num_left = jnp.where(missing, dl[idx], v0 <= thr[idx])
-        # categorical decision: membership in bitset -> left (tree.h:402)
-        iv = jnp.where(is_nan | (v < 0), -1, v).astype(jnp.int32)
-        cat_left = _cat_contains(bitset[idx], iv)
-        return jnp.where(is_cat[idx], cat_left, num_left)
+        num_left = jnp.where(missing, dl[i], v0 <= thr[i])
+
+        def cat_branch():
+            # membership in the node's u32 bitset (tree.h:402): the
+            # word lookup unrolls over the W (small) bitset words —
+            # a per-row bitset[word] gather would serialize. NOTE:
+            # under _forest_leaves' vmap the cond lowers to a select
+            # and this branch runs for numeric nodes too; at W words
+            # it is a handful of [n] selects, which is still far
+            # cheaper than any gather formulation
+            iv = jnp.where(is_nan | (v < 0), -1, v).astype(jnp.int32)
+            word = iv // 32
+            bit = (iv % 32).astype(jnp.uint32)
+            bits = bitset[i]                          # [W] u32
+            W = bits.shape[0]
+            w = jnp.zeros((n,), jnp.uint32)
+            for k in range(W):
+                w = jnp.where(word == k, bits[k], w)
+            return (iv >= 0) & (word < W) \
+                & (((w >> bit) & 1) != 0)
+
+        return lax.cond(is_cat[i], cat_branch, lambda: num_left)
 
     return _traverse(n, decide, tree.left_child[ti], tree.right_child[ti])
 
