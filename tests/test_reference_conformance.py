@@ -205,3 +205,33 @@ def test_reference_ranker_model_cross_loads():
     d = np.abs(p - ref)
     assert np.median(d) < 1e-6
     assert d.max() < 1e-4
+
+
+def test_reference_regression_model_cross_loads():
+    bst = lgb.Booster(model_file=os.path.join(_DATA,
+                                              "regression.model.txt"))
+    assert bst.num_trees() == 100
+    X = np.loadtxt(os.path.join(REF, "regression", "regression.test"),
+                   delimiter="\t")[:, 1:]
+    p = bst.predict(X)
+    ref = np.loadtxt(os.path.join(_DATA, "regression.pred.txt"))
+    d = np.abs(p - ref)
+    assert np.median(d) < 1e-6
+    assert np.mean(d < 1e-5) >= 0.98
+
+
+def test_reference_multiclass_model_cross_loads():
+    bst = lgb.Booster(model_file=os.path.join(_DATA,
+                                              "multiclass.model.txt"))
+    assert bst.num_trees() == 500  # 100 iters x 5 classes
+    X = np.loadtxt(os.path.join(REF, "multiclass_classification",
+                                "multiclass.test"), delimiter="\t")[:, 1:]
+    p = bst.predict(X)
+    ref = np.loadtxt(os.path.join(_DATA, "multiclass.pred.txt"))
+    assert p.shape == ref.shape
+    d = np.abs(p - ref)
+    assert np.median(d) < 1e-6
+    # softmax couples classes: one f32-boundary-flipped tree perturbs
+    # all 5 class probabilities of that row
+    assert np.mean(d < 1e-4) >= 0.95
+    assert d.max() < 0.05
