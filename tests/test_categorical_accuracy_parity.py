@@ -1,0 +1,72 @@
+"""Accuracy impact of the documented semantic relaxations, measured
+against the reference on realistic categorical data.
+
+Two places deliberately relax reference semantics (docstrings in
+ops/split.py and ops/grow.py):
+- categorical ``min_data_per_group`` uses hessian-ratio count
+  estimates per category group instead of exact per-group counts;
+- quantized training estimates per-bin data counts from the quantized
+  hessian sum.
+
+Oracle: the reference CLI (built as documented in
+tests/data/README.md) trained on the byte-identical seed-42 dataset
+below (3 high-cardinality categoricals with group effects + 2
+numerics + 1 noise categorical; 6000 train / 2000 test rows,
+categorical_feature=0,1,2,3, 60 iters, 31 leaves, lr 0.1,
+min_data_in_leaf 20) reaches held-out AUC 0.925362. Both our float
+and quantized paths must land within noise of that.
+"""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+REF_AUC = 0.925362
+
+
+def _data():
+    rs = np.random.RandomState(42)
+    n = 8000
+    c1 = rs.randint(0, 40, n)
+    c2 = rs.randint(0, 12, n)
+    c3 = rs.randint(0, 100, n)
+    cnoise = rs.randint(0, 25, n)
+    x1 = rs.randn(n)
+    x2 = rs.randn(n)
+    logit = (rs.randn(40)[c1] + rs.randn(12)[c2] * 0.7
+             + rs.randn(100)[c3] * 0.5 + 0.6 * x1 - 0.4 * x2
+             + 0.8 * rs.randn(n))
+    y = (logit > 0).astype(float)
+    X = np.column_stack([c1, c2, c3, cnoise, x1, x2]).astype(np.float64)
+    return X, y
+
+
+def _auc(yv, p):
+    o = np.argsort(p)
+    r = np.empty(len(p))
+    r[o] = np.arange(1, len(p) + 1)
+    npos = yv.sum()
+    nneg = len(yv) - npos
+    return (r[yv == 1].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+
+
+def _train_auc(extra=None):
+    X, y = _data()
+    params = {"objective": "binary", "num_leaves": 31,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbosity": -1}
+    params.update(extra or {})
+    d = lgb.Dataset(X[:6000], label=y[:6000],
+                    categorical_feature=[0, 1, 2, 3])
+    bst = lgb.train(params, d, 60)
+    return _auc(y[6000:], bst.predict(X[6000:]))
+
+
+def test_categorical_float_matches_reference_auc():
+    a = _train_auc()
+    assert abs(a - REF_AUC) < 0.004, (a, REF_AUC)
+
+
+def test_categorical_quantized_matches_reference_auc():
+    a = _train_auc({"use_quantized_grad": True})
+    assert abs(a - REF_AUC) < 0.006, (a, REF_AUC)
