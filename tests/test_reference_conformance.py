@@ -235,3 +235,48 @@ def test_reference_multiclass_model_cross_loads():
     # all 5 class probabilities of that row
     assert np.mean(d < 1e-4) >= 0.95
     assert d.max() < 0.05
+
+
+def test_xendcg_example():
+    """The xendcg example (objective=rank_xendcg) trains to a ranking
+    quality well above random on its own validation queries — the same
+    bar the lambdarank example is held to."""
+    conf = _load_conf("xendcg")
+    base = os.path.join(REF, "xendcg")
+    train = lgb.Dataset(os.path.join(base, conf["data"]),
+                        params={"label_column":
+                                conf.get("label_column", "0")})
+    params = _params_from_conf(conf)
+    bst = lgb.train(params, train, num_boost_round=50)
+
+    labels, rows = [], []
+    nf = bst.num_feature()
+    with open(os.path.join(base, "rank.test")) as fh:
+        for line in fh:
+            parts = line.split()
+            labels.append(float(parts[0]))
+            row = np.zeros(nf)
+            for tok in parts[1:]:
+                i, v = tok.split(":")
+                if int(i) < nf:
+                    row[int(i)] = float(v)
+            rows.append(row)
+    y, X = np.asarray(labels), np.asarray(rows)
+    qs = np.loadtxt(os.path.join(base, "rank.test.query")).astype(int)
+    p = bst.predict(X)
+
+    total, cnt, off = 0.0, 0, 0
+    for q in qs:
+        yy, pp = y[off:off + q], p[off:off + q]
+        off += q
+        if yy.max() <= 0:
+            continue
+        top = np.argsort(-pp)[:5]
+        dcg = np.sum((2.0 ** yy[top] - 1)
+                     / np.log2(np.arange(2, len(top) + 2)))
+        ideal = np.sort(yy)[::-1][:5]
+        idcg = np.sum((2.0 ** ideal - 1)
+                      / np.log2(np.arange(2, len(ideal) + 2)))
+        total += dcg / idcg
+        cnt += 1
+    assert total / max(cnt, 1) > 0.60, total / max(cnt, 1)
