@@ -825,7 +825,11 @@ def _grow_compact_impl(cfg: GrowConfig,
 
     has_cat = feat_is_cat is not None
     bin_dt = bins_T.dtype
-    pack_w = 4 if bin_dt == jnp.uint8 else 2      # bin cols per u32 word
+    # bin columns per u32 word of the streamed copy: 8 when every
+    # feature fits 4 bits (the reference's 4-bit DenseBin,
+    # src/io/dense_bin.hpp is_4bit path), else 4 (u8) / 2 (u16)
+    nibble_bins = bin_dt == jnp.uint8 and B <= 16
+    pack_w = 8 if nibble_bins else (4 if bin_dt == jnp.uint8 else 2)
     Fp = -(-F // pack_w) * pack_w
     NW = Fp // pack_w                             # u32 words per row
 
@@ -861,7 +865,12 @@ def _grow_compact_impl(cfg: GrowConfig,
 
     def _unpack_bins(cols):
         w32 = jnp.stack(cols, axis=1)                     # [K, NW]
-        u = lax.bitcast_convert_type(w32, bin_dt)         # [K, NW, pack_w]
+        if nibble_bins:
+            nibs = [((w32 >> (4 * k)) & 0xF).astype(bin_dt)
+                    for k in range(8)]                    # 8 x [K, NW]
+            u = jnp.stack(nibs, axis=2)                   # [K, NW, 8]
+        else:
+            u = lax.bitcast_convert_type(w32, bin_dt)     # [K, NW, pack_w]
         return u.reshape(K, Fp)[:, :F]
 
     def rot(a, s):
@@ -1204,8 +1213,12 @@ def _grow_compact_impl(cfg: GrowConfig,
     # that taxes every dynamic slice / masked RMW ~2-4x)
     bins_pk = bins_rm if Fp == F \
         else jnp.pad(bins_rm, ((0, 0), (0, Fp - F)))
-    bins_pk = lax.bitcast_convert_type(
-        bins_pk.reshape(n, NW, pack_w), jnp.uint32)        # [n, NW]
+    if nibble_bins:
+        nib = bins_pk.reshape(n, NW, 8).astype(jnp.uint32)
+        bins_pk = sum(nib[:, :, k] << (4 * k) for k in range(8))
+    else:
+        bins_pk = lax.bitcast_convert_type(
+            bins_pk.reshape(n, NW, pack_w), jnp.uint32)    # [n, NW]
     ord0 = jnp.arange(n, dtype=jnp.uint32) \
         | jnp.where(inbag, _IB_BIT, jnp.uint32(0))
     state = _CompactState(

@@ -44,6 +44,41 @@ def _auc(y, p):
     return (rank[y > 0].sum() - npos * (npos + 1) / 2) / (npos * nneg)
 
 
+def _load_libsvm(path, nf):
+    """LibSVM rows -> dense [n, nf] + labels (0-based indices, the
+    reference parser's convention)."""
+    labels, rows = [], []
+    with open(path) as fh:
+        for line in fh:
+            parts = line.split()
+            labels.append(float(parts[0]))
+            row = np.zeros(nf)
+            for tok in parts[1:]:
+                i, v = tok.split(":")
+                if int(i) < nf:
+                    row[int(i)] = float(v)
+            rows.append(row)
+    return np.asarray(rows), np.asarray(labels)
+
+
+def _ndcg_at(y, p, qs, k):
+    total, cnt, off = 0.0, 0, 0
+    for q in qs:
+        yy, pp = y[off:off + q], p[off:off + q]
+        off += q
+        if yy.max() <= 0:
+            continue
+        top = np.argsort(-pp)[:k]
+        dcg = np.sum((2.0 ** yy[top] - 1)
+                     / np.log2(np.arange(2, len(top) + 2)))
+        ideal = np.sort(yy)[::-1][:k]
+        idcg = np.sum((2.0 ** ideal - 1)
+                      / np.log2(np.arange(2, len(ideal) + 2)))
+        total += dcg / idcg
+        cnt += 1
+    return total / max(cnt, 1)
+
+
 def test_binary_classification_example():
     conf = _load_conf("binary_classification")
     base = os.path.join(REF, "binary_classification")
@@ -74,42 +109,13 @@ def test_lambdarank_example():
     bst = lgb.train(params, train, num_boost_round=50)
 
     # rank.test is LibSVM-formatted (label idx:value ...)
-    labels, rows = [], []
-    nf = bst.num_feature()
-    with open(os.path.join(base, "rank.test")) as fh:
-        for line in fh:
-            parts = line.split()
-            labels.append(float(parts[0]))
-            row = np.zeros(nf)
-            for tok in parts[1:]:
-                i, v = tok.split(":")
-                if int(i) < nf:
-                    row[int(i)] = float(v)
-            rows.append(row)
-    y, X = np.asarray(labels), np.asarray(rows)
+    X, y = _load_libsvm(os.path.join(base, "rank.test"),
+                        bst.num_feature())
     qs = np.loadtxt(os.path.join(base, "rank.test.query")).astype(int)
     p = bst.predict(X)
-
-    def ndcg_at(k):
-        total, cnt, off = 0.0, 0, 0
-        for q in qs:
-            yy, pp = y[off:off + q], p[off:off + q]
-            off += q
-            if yy.max() <= 0:
-                continue
-            top = np.argsort(-pp)[:k]
-            dcg = np.sum((2.0 ** yy[top] - 1)
-                         / np.log2(np.arange(2, len(top) + 2)))
-            ideal = np.sort(yy)[::-1][:k]
-            idcg = np.sum((2.0 ** ideal - 1)
-                          / np.log2(np.arange(2, len(ideal) + 2)))
-            total += dcg / idcg
-            cnt += 1
-        return total / max(cnt, 1)
-
     # calibration on this dataset: random ranking scores ndcg@5 ~0.47;
     # the trained model must sit well above it
-    assert ndcg_at(5) > 0.60, ndcg_at(5)
+    assert _ndcg_at(y, p, qs, 5) > 0.60, _ndcg_at(y, p, qs, 5)
 
 
 def test_multiclass_example():
@@ -249,34 +255,8 @@ def test_xendcg_example():
     params = _params_from_conf(conf)
     bst = lgb.train(params, train, num_boost_round=50)
 
-    labels, rows = [], []
-    nf = bst.num_feature()
-    with open(os.path.join(base, "rank.test")) as fh:
-        for line in fh:
-            parts = line.split()
-            labels.append(float(parts[0]))
-            row = np.zeros(nf)
-            for tok in parts[1:]:
-                i, v = tok.split(":")
-                if int(i) < nf:
-                    row[int(i)] = float(v)
-            rows.append(row)
-    y, X = np.asarray(labels), np.asarray(rows)
+    X, y = _load_libsvm(os.path.join(base, "rank.test"),
+                        bst.num_feature())
     qs = np.loadtxt(os.path.join(base, "rank.test.query")).astype(int)
     p = bst.predict(X)
-
-    total, cnt, off = 0.0, 0, 0
-    for q in qs:
-        yy, pp = y[off:off + q], p[off:off + q]
-        off += q
-        if yy.max() <= 0:
-            continue
-        top = np.argsort(-pp)[:5]
-        dcg = np.sum((2.0 ** yy[top] - 1)
-                     / np.log2(np.arange(2, len(top) + 2)))
-        ideal = np.sort(yy)[::-1][:5]
-        idcg = np.sum((2.0 ** ideal - 1)
-                      / np.log2(np.arange(2, len(ideal) + 2)))
-        total += dcg / idcg
-        cnt += 1
-    assert total / max(cnt, 1) > 0.60, total / max(cnt, 1)
+    assert _ndcg_at(y, p, qs, 5) > 0.60, _ndcg_at(y, p, qs, 5)
