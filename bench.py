@@ -20,6 +20,7 @@ absolute Higgs 0.8457, is the check).
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -27,6 +28,72 @@ import numpy as np
 
 BASELINE_ITERS_PER_SEC = 500.0 / 130.094
 HIGGS_ROWS = 10_500_000
+
+# Resilience: the driver runs this through a TPU tunnel that has died
+# mid-round twice (BENCH_r01/r03 captured stack traces, not numbers).
+# Probe the backend with retry/backoff before committing to the big
+# run, and on hard failure still emit the ONE json line — with an
+# "error" field and the last builder-measured number — so the round
+# record is data, not a traceback.
+PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", 10))
+PROBE_BACKOFF_S = float(os.environ.get("BENCH_PROBE_BACKOFF", 30.0))
+# last full-scale number measured by the builder on a real chip
+# (10.5M x 28, 255 leaves/bins; see benchmarks/PROFILE.md)
+LAST_MEASURED = {"value": 1.12, "unit": "iters/sec",
+                 "vs_baseline": 0.293, "commit": "3cef1da"}
+
+
+def _git_head():
+    try:
+        return subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except Exception:
+        return "unknown"
+
+
+def _probe_backend():
+    """Wait for a usable JAX backend; returns jax or raises last error."""
+    last = None
+    for attempt in range(PROBE_RETRIES):
+        try:
+            import jax
+            jax.devices()  # forces backend init
+            return jax
+        except Exception as e:  # backend init failure (tunnel down)
+            last = e
+            # jax caches a failed backend init in-process; a retry needs
+            # a fresh interpreter. Sleep, then re-exec ourselves with a
+            # decremented retry budget.
+            sys.stderr.write(
+                f"bench: backend probe {attempt + 1}/{PROBE_RETRIES} "
+                f"failed: {e}\n")
+            if attempt + 1 < PROBE_RETRIES:
+                time.sleep(PROBE_BACKOFF_S)
+                env = dict(os.environ)
+                env["BENCH_PROBE_RETRIES"] = str(
+                    PROBE_RETRIES - attempt - 1)
+                os.execve(sys.executable,
+                          [sys.executable] + sys.argv, env)
+    raise last
+
+
+def _emit_failure(err):
+    """One JSON line recording the failure + the last known number."""
+    result = {
+        "metric": "boosting iters/sec, Higgs-shaped "
+                  f"{N_ROWS}x{N_FEATURES}, {NUM_LEAVES} leaves, "
+                  f"{MAX_BIN} bins (BENCH FAILED - last measured value "
+                  "reported)",
+        "value": LAST_MEASURED["value"],
+        "unit": LAST_MEASURED["unit"],
+        "vs_baseline": LAST_MEASURED["vs_baseline"],
+        "error": f"{type(err).__name__}: {err}"[:500],
+        "measured_at_commit": LAST_MEASURED["commit"],
+        "failed_at_commit": _git_head(),
+    }
+    print(json.dumps(result))
 
 # default = the REAL Higgs shape: measured, not extrapolated
 N_ROWS = int(os.environ.get("BENCH_ROWS", HIGGS_ROWS))
@@ -67,7 +134,7 @@ def main():
     # (shape, config); repeated bench runs skip the 20-40s TPU compile
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           os.path.expanduser("~/.cache/lightgbm_tpu/xla"))
-    import jax
+    jax = _probe_backend()
     import lightgbm_tpu as lgb
 
     X, y = make_higgs_like(N_ROWS + N_VALID, N_FEATURES)
@@ -133,4 +200,9 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as err:  # emit data, never a bare stack trace
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        _emit_failure(err)

@@ -502,6 +502,11 @@ class Config:
     # costs but pay more window-tail padding and higher per-row sort
     # depth — 16384 measured best on v5e, benchmarks/PROFILE.md)
     chunk_rows: int = 16384
+    # bulk-batching chunk size: the partition streams floor(cnt/
+    # big_chunk_rows) big bodies per leaf window before the chunk_rows
+    # tail (GrowConfig.big_chunk). Measured neutral-to-negative on v5e
+    # (the body is throughput- not dispatch-bound); 0 (default) off.
+    big_chunk_rows: int = 0
 
     # Unrecognized parameters are kept here (warned about, not fatal).
     extra: Dict[str, Any] = field(default_factory=dict)
@@ -579,6 +584,12 @@ class Config:
                                      & (self.chunk_rows - 1)) != 0:
             raise ValueError("chunk_rows must be a power of two >= 256, "
                              f"got {self.chunk_rows}")
+        if self.big_chunk_rows != 0 and (
+                self.big_chunk_rows < self.chunk_rows
+                or (self.big_chunk_rows & (self.big_chunk_rows - 1)) != 0):
+            raise ValueError(
+                "big_chunk_rows must be 0 or a power of two >= "
+                f"chunk_rows, got {self.big_chunk_rows}")
         if self.hist_precision not in ("default", "high", "highest"):
             raise ValueError(
                 f"Unknown hist_precision: {self.hist_precision}")

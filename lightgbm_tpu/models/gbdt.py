@@ -47,7 +47,10 @@ def _tree_values_binned(split_feature, threshold_bin, default_left,
     leaves = predict_leaf_binned(split_feature, threshold_bin, default_left,
                                  left_child, right_child, feat_nan_bin,
                                  bins_T, is_cat, cat_masks)
-    return leaf_value[leaves]
+    # gather_small, not leaf_value[leaves]: the [n]-sized small-table
+    # gather costs ~8.6 ms/M rows on TPU (benchmarks/PROFILE.md) and
+    # valid-set scoring pays it every iteration
+    return gather_small(leaf_value, leaves)
 
 
 @jax.jit
@@ -195,6 +198,7 @@ class GBDTBooster:
             max_depth=cfg.max_depth,
             grower=grower,
             chunk=cfg.chunk_rows,
+            big_chunk=cfg.big_chunk_rows,
             hist_method=hist_method,
             hist_precision=cfg.hist_precision,
             quantized=cfg.use_quantized_grad,

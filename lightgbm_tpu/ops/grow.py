@@ -63,6 +63,15 @@ class GrowConfig(NamedTuple):
     hist_method: str = "scatter"
     hist_precision: str = "default"  # mxu matmul passes: default|high|highest
     chunk: int = 16384           # rows per streaming chunk (compact grower)
+    # Bulk-batching chunk size: each leaf window is partitioned as
+    # floor(cnt/big_chunk) BIG chunks followed by K-sized tail chunks.
+    # MEASURED NEUTRAL-TO-NEGATIVE on v5e (round 4: 158->162 ms/tree at
+    # 1M rows with 131072, 392->428 ms at 10.5M): the chunk body is
+    # throughput-bound (the bitonic sort's per-row work grows ~log^2 CK,
+    # cancelling the amortized dispatch overhead), NOT dispatch-bound as
+    # PROFILE.md round-3 option 2 hypothesized. Kept as a tuning knob;
+    # 0 (default) disables.
+    big_chunk: int = 0
     axis_name: Optional[str] = None
     grower: str = "compact"
     # quantized-gradient training (use_quantized_grad; the reference's
@@ -599,6 +608,13 @@ def _grow_compact_impl(cfg: GrowConfig,
     route = cfg.partition == "route"
     if route:
         K = 1 << (K.bit_length() - 1)   # butterfly needs a power of two
+    # big-chunk bulk batching (see GrowConfig.big_chunk); the butterfly
+    # router is K-sized, so route mode keeps the tail loop only
+    BK = cfg.big_chunk
+    while BK >= 2 * n:
+        BK //= 2
+    use_big = (not route) and BK > K
+    PAD = BK if use_big else K   # write-tail padding absorbs one chunk
 
     fp = cfg.axis_name is not None and cfg.parallel_mode == "feature"
     vp = cfg.axis_name is not None and cfg.parallel_mode == "voting"
@@ -670,29 +686,47 @@ def _grow_compact_impl(cfg: GrowConfig,
                                            bundle_is_direct,
                                            feat_nan_bin, fmask, p)
         if fp:
-            # disjoint round-robin feature ownership; each device
-            # searches its own columns, then the global best SplitInfo
-            # is allreduced (FeatureParallelTreeLearner, feature_
-            # parallel_tree_learner.cpp:71 — rows are replicated, so
-            # histograms need no reduction; the TPU's fused MXU
-            # histogram still covers all features, the sharding lives
-            # in the split search)
-            dev = lax.axis_index(cfg.axis_name)
-            ndev = lax.axis_size(cfg.axis_name)
-            own = (jnp.arange(F) % ndev) == dev
-            r = find_best_split(hist, sg, sh, sc, feat_num_bins,
-                                feat_nan_bin, fmask & own, p,
-                                monotone_constraints, feat_is_cat,
-                                gain_penalty, parent_output, depth,
-                                bounds)
+            # disjoint feature ownership over word-aligned windows: the
+            # device's histogram covers ONLY its own Fl columns (built
+            # that way, _local_hist_rows), its search runs on the
+            # matching slice of the per-feature metadata masked to the
+            # features it OWNS (windows of tail devices overlap when D
+            # does not divide NW; _fp_owner keeps the cover exact), and
+            # the winning SplitInfo is allreduced with the feature id
+            # globalized (FeatureParallelTreeLearner,
+            # feature_parallel_tree_learner.cpp:71 +
+            # SyncUpGlobalBestSplit)
+            def lsl(v, fill):
+                """Device's Fl-slice of a per-feature vector (padded to
+                the packed width so the window stays in range)."""
+                if v is None:
+                    return None
+                if Fp > F:
+                    pad = jnp.full((Fp - F,), fill, v.dtype)
+                    v = jnp.concatenate([v, pad])
+                return lax.dynamic_slice(v, (f_start,), (Fl,))
+
+            owned = _fp_owner(f_start + jnp.arange(Fl)) == dev_idx
+            r = find_best_split(hist, sg, sh, sc,
+                                lsl(feat_num_bins, 1),
+                                lsl(feat_nan_bin, -1),
+                                lsl(fmask, False) & owned, p,
+                                lsl(monotone_constraints, 0),
+                                lsl(feat_is_cat, False),
+                                lsl(gain_penalty, 0.0),
+                                parent_output, depth, bounds)
+            r = r._replace(feature=r.feature + f_start)
             return _fp_combine(r)
         if vp:
             # PV-Tree (VotingParallelTreeLearner, voting_parallel_tree_
             # learner.cpp:364): local top-k ballot over per-feature best
-            # gains -> global election of 2k features -> reduce only the
-            # elected histograms -> one global search over them. The
-            # reduction here is a masked full-width psum (exchanging
-            # just the elected rows is a DCN-mesh optimization).
+            # gains -> global election of 2k features -> reduce ONLY the
+            # elected features' histograms -> one global search over
+            # them. The exchanged payload is the static-shape [k2, B, C]
+            # selection (k2 = min(2k, F)) — O(2k*B) bytes on the wire
+            # per search like the reference's CopyLocalHistogram buffer
+            # (parallel_tree_learner.h:153-161), not the full
+            # O(F*B) a data-parallel reduction pays.
             ax = cfg.axis_name
             # the ballot judges LOCAL histograms, so it must use local
             # leaf sums and shard-scaled data constraints (the
@@ -715,10 +749,20 @@ def _grow_compact_impl(cfg: GrowConfig,
             ballot = jnp.isfinite(fgains) & (fgains >= kth)
             votes = lax.psum(ballot.astype(jnp.int32), ax)
             k2 = min(2 * cfg.voting_top_k, F)
+            # deterministic election, identical on every device: vote
+            # count, ties to the lower feature id (GlobalVoting,
+            # voting_parallel_tree_learner.cpp:205)
             score = votes * F + (F - 1 - jnp.arange(F))
-            elected = score >= jnp.sort(score)[F - k2]
-            ghist = lax.psum(
-                hist * elected[:, None, None].astype(hist.dtype), ax)
+            idx = lax.top_k(score, k2)[1]                 # [k2]
+            E = idx[:, None] == jnp.arange(F)[None, :]    # [k2, F] bool
+            elected = jnp.any(E, axis=0)                  # [F]
+            # select elected rows (masked reduce, exact for int32 too),
+            # psum the SMALL [k2, B, C] buffer, scatter back
+            sel = jnp.sum(jnp.where(E[:, :, None, None], hist[None], 0),
+                          axis=1)                         # [k2, B, C]
+            gsel = lax.psum(sel, ax)
+            ghist = jnp.sum(jnp.where(E[:, :, None, None], gsel[:, None],
+                                      0), axis=0)         # [F, B, C]
             return find_best_split(ghist, sg, sh, sc, feat_num_bins,
                                    feat_nan_bin, fmask & elected, p,
                                    monotone_constraints, feat_is_cat,
@@ -818,7 +862,6 @@ def _grow_compact_impl(cfg: GrowConfig,
     # rows of padding so chunk slices/updates never clamp at the end;
     # garbage lands in (and is read from) the pad region and is masked.
     C = 2
-    iota_k = jnp.arange(K)
 
     def window_chunks(cnt):
         return lax.div(cnt + (K - 1), jnp.asarray(K, cnt.dtype))
@@ -832,6 +875,34 @@ def _grow_compact_impl(cfg: GrowConfig,
     pack_w = 8 if nibble_bins else (4 if bin_dt == jnp.uint8 else 2)
     Fp = -(-F // pack_w) * pack_w
     NW = Fp // pack_w                             # u32 words per row
+
+    # feature-parallel work sharding: each device owns a word-aligned
+    # block of NWl packed words (Fl = NWl*pack_w feature columns) and
+    # builds histograms ONLY for that block — F/D of the MXU hist work,
+    # the TPU analog of each rank's ConstructHistograms over its own
+    # subset (feature_parallel_tree_learner.cpp:71). Rows stay
+    # replicated (like the reference: full data on every worker, so the
+    # partition needs no collective); only the winning SplitInfo is
+    # allreduced (_fp_combine). When D does not divide NW the tail
+    # devices' windows CLAMP to the last NWl words (so the hist slice
+    # never reads out of range) and ownership inside the overlapping
+    # windows is made exact by ``_fp_owned``: feature f belongs to
+    # device min(f // Fl, D-1) only — each device's search mask keeps
+    # just its owned columns, so hist rows and metadata stay aligned.
+    if fp:
+        D_fp = lax.axis_size(cfg.axis_name)       # static under shard_map
+        dev_idx = lax.axis_index(cfg.axis_name)   # traced
+        NWl = -(-NW // D_fp)
+        Fl = NWl * pack_w
+        # this device's window start, in words / in feature columns
+        w_start = jnp.minimum(dev_idx * NWl, NW - NWl)
+        f_start = w_start * pack_w
+
+        def _fp_owner(f):
+            return jnp.minimum(f // Fl, D_fp - 1)
+    else:
+        Fl = F
+    FH = Fl if fp else F                          # hist feature count
 
     def chunk_goleft(blk_b, f, t, dl, isc, cm):
         """go-left decision for one chunk — all vector ops (a cm[col]
@@ -863,23 +934,38 @@ def _grow_compact_impl(cfg: GrowConfig,
             gl = jnp.where(isc, cm_col, gl)
         return gl
 
-    def _unpack_bins(cols):
-        w32 = jnp.stack(cols, axis=1)                     # [K, NW]
+    def _unpack_words(w32):
+        """[S, nw] u32 words -> [S, nw*pack_w] native-width bins."""
+        S, nw = w32.shape
         if nibble_bins:
             nibs = [((w32 >> (4 * k)) & 0xF).astype(bin_dt)
-                    for k in range(8)]                    # 8 x [K, NW]
-            u = jnp.stack(nibs, axis=2)                   # [K, NW, 8]
+                    for k in range(8)]                    # 8 x [S, nw]
+            u = jnp.stack(nibs, axis=2)                   # [S, nw, 8]
         else:
-            u = lax.bitcast_convert_type(w32, bin_dt)     # [K, NW, pack_w]
-        return u.reshape(K, Fp)[:, :F]
+            u = lax.bitcast_convert_type(w32, bin_dt)     # [S, nw, pack_w]
+        return u.reshape(S, nw * pack_w)
+
+    def _unpack_bins(cols):
+        return _unpack_words(jnp.stack(cols, axis=1))[:, :F]
+
+    def _local_hist_rows(w32, pos0, CK):
+        """The rows fed to the MXU histogram: all F features, or — in
+        feature-parallel — ONLY this device's NWl-word block (F/D of
+        the one-hot/matmul work)."""
+        if fp:
+            blk = lax.dynamic_slice(w32, (pos0, w_start), (CK, NWl))
+            return _unpack_words(blk)                     # [CK, Fl]
+        blk = lax.dynamic_slice(w32, (pos0, 0), (CK, NW))
+        return _unpack_words(blk)[:, :F]
 
     def rot(a, s):
-        """a shifted so that out[j] = a[j - (K - s)] — dynamic roll via
+        """a shifted so that out[j] = a[j - (CK - s)] — dynamic roll via
         self-concatenation (vectorized; no per-element gather)."""
         if a.ndim == 2:
             return lax.dynamic_slice(jnp.concatenate([a, a], axis=0),
-                                     (s, 0), (K, a.shape[1]))
-        return lax.dynamic_slice(jnp.concatenate([a, a]), (s,), (K,))
+                                     (s, 0), (a.shape[0], a.shape[1]))
+        return lax.dynamic_slice(jnp.concatenate([a, a]), (s,),
+                                 (a.shape[0],))
 
     # bf16 payload storage on TPU: the streamed (g, h) pairs only ever
     # feed the MXU histogram, whose single-pass default truncates f32
@@ -894,21 +980,22 @@ def _grow_compact_impl(cfg: GrowConfig,
         # int8 (g, h) pairs ride the sort as ONE u16 column
         def _pack_pay(blk_p):
             return (lax.bitcast_convert_type(
-                blk_p.reshape(K, 1, 2), jnp.uint16)[:, 0],)
+                blk_p.reshape(blk_p.shape[0], 1, 2), jnp.uint16)[:, 0],)
 
         def _unpack_pay(cols):
-            return lax.bitcast_convert_type(cols[0][:, None],
-                                            jnp.int8).reshape(K, 2)
+            return lax.bitcast_convert_type(
+                cols[0][:, None], jnp.int8).reshape(cols[0].shape[0], 2)
         NPAY = 1
     elif bf16_pay:
         # bf16 (g, h) pairs ride the sort as ONE u32 column
         def _pack_pay(blk_p):
             return (lax.bitcast_convert_type(
-                blk_p.reshape(K, 1, 2), jnp.uint32)[:, 0],)
+                blk_p.reshape(blk_p.shape[0], 1, 2), jnp.uint32)[:, 0],)
 
         def _unpack_pay(cols):
-            return lax.bitcast_convert_type(cols[0][:, None],
-                                            jnp.bfloat16).reshape(K, 2)
+            return lax.bitcast_convert_type(
+                cols[0][:, None],
+                jnp.bfloat16).reshape(cols[0].shape[0], 2)
         NPAY = 1
     else:
         def _pack_pay(blk_p):
@@ -918,19 +1005,17 @@ def _grow_compact_impl(cfg: GrowConfig,
             return jnp.stack(cols, axis=1)
         NPAY = 2
 
-    SEG = n + 2 * K  # rows per ping-pong half (K pad on both sides)
+    SEG = n + 2 * PAD  # rows per ping-pong half (PAD rows both sides)
 
-    def chunk_hist(bins2, pay2, base, c, limit):
-        """Histogram of one K-row chunk at dynamic row offset
-        ``base + c*K``: slice the packed bin words + payload, mask the
-        window tail (rows past ``limit``), accumulate on the MXU.
-        Shared by the post-partition child pass and the pool-miss
-        window recompute."""
-        pos0 = base + c * K
-        blk_w = lax.dynamic_slice(bins2, (pos0, 0), (K, NW))
-        blk_b = _unpack_bins(tuple(blk_w[:, i] for i in range(NW)))
-        blk_p = lax.dynamic_slice(pay2, (pos0, 0), (K, C))
-        valid = iota_k < jnp.clip(limit - c * K, 0, K)
+    def chunk_hist(bins2, pay2, pos0, limit, CK):
+        """Histogram of one CK-row chunk at dynamic row offset ``pos0``:
+        slice the packed bin words + payload, mask the window tail
+        (rows past ``limit`` relative to the chunk start), accumulate
+        on the MXU. Shared by the post-partition child pass and the
+        pool-miss window recompute."""
+        blk_b = _local_hist_rows(bins2, pos0, CK)
+        blk_p = lax.dynamic_slice(pay2, (pos0, 0), (CK, C))
+        valid = jnp.arange(CK) < jnp.clip(limit, 0, CK)
         hp = blk_p * valid[:, None].astype(blk_p.dtype)
         if quant:
             return hist_from_rows_int(blk_b, hp, B, hmethod), valid
@@ -943,7 +1028,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         streaming pass over the leaf's window.
 
         The two ping-pong halves live in one flat array; the half
-        choice is plain row-offset arithmetic (``b*SEG + K``), so every
+        choice is plain row-offset arithmetic (``b*SEG + PAD``), so every
         access is the dynamic-row-slice pattern XLA:TPU aliases well —
         no conditional branches, no dynamic major-axis indexing.
 
@@ -976,93 +1061,113 @@ def _grow_compact_impl(cfg: GrowConfig,
         exist after the pass; estimates are deterministic and
         replicated across shards).
         """
-        src_base = src * SEG + K + start
-        dst_base = (1 - src) * SEG + K + start
+        src_base = src * SEG + PAD + start
+        dst_base = (1 - src) * SEG + PAD + start
         zero = jnp.asarray(0, jnp.int32)
-        acc0 = jnp.zeros((F, B, C), jnp.int32 if quant else dtype)
+        acc0 = jnp.zeros((FH, B, C), jnp.int32 if quant else dtype)
 
         def write(arr, off, block, m):
             """Masked RMW block write at a dynamic row offset."""
             if arr.ndim == 2:
                 cur = lax.dynamic_slice(arr, (off, 0),
-                                        (K, arr.shape[1]))
+                                        (block.shape[0], arr.shape[1]))
                 out = jnp.where(m[:, None], block, cur)
                 return lax.dynamic_update_slice(arr, out, (off, 0))
-            cur = lax.dynamic_slice(arr, (off,), (K,))
+            cur = lax.dynamic_slice(arr, (off,), (block.shape[0],))
             out = jnp.where(m, block, cur)
             return lax.dynamic_update_slice(arr, out, (off,))
 
-        def body(c, carry):
-            (bins2, pay2, ord2, lazy_used,
-             l_off, r_off, nlib, nib) = carry
-            pos0 = src_base + c * K
-            blk_w = lax.dynamic_slice(bins2, (pos0, 0), (K, NW))
-            blk_b = _unpack_bins(tuple(blk_w[:, i] for i in range(NW)))
-            blk_p = lax.dynamic_slice(pay2, (pos0, 0), (K, C))
-            blk_o = lax.dynamic_slice(ord2, (pos0,), (K,))
-            blk_i = (blk_o & _IB_BIT) != 0
-            gl = chunk_goleft(blk_b, f, t, dl, isc, cm)
-            valid = iota_k < jnp.clip(cnt - c * K, 0, K)
-            vl = valid & gl
-            l_c = jnp.sum(vl.astype(jnp.int32))
-            r_c = jnp.sum((valid & ~gl).astype(jnp.int32))
-            nlib += jnp.sum((vl & blk_i).astype(jnp.int32))
-            nib += jnp.sum((valid & blk_i).astype(jnp.int32))
-            if cegb_lazy:
-                rows = (blk_o & ~_IB_BIT).astype(jnp.int32)
-                # the split acquires feature f for every in-bag row in
-                # the leaf (UpdateLeafBestSplits' InsertBitset loop
-                # over the bagged partition)
-                lazy_used = lazy_used.at[rows, f].max(valid & blk_i)
-            # the sort/route move the PACKED u32 word columns; children
-            # are written back packed too — bins only ever unpack
-            # transiently for goleft/histogram (bins2 stays u32-tiled,
-            # avoiding the u8 (4,1) sub-byte layout tax on every
-            # slice/RMW write)
-            cols = tuple(blk_w[:, i] for i in range(NW)) \
-                + _pack_pay(blk_p) + (blk_o,)
-            ml = iota_k < l_c
-            o_r = dst_base + cnt - r_off - K
-            mr = iota_k >= (K - r_c)
-            if route:
-                # two butterfly concentrations: lefts compact to the
-                # block FRONT, rights directly to the block END (no
-                # rotate needed — the offset is part of the route).
-                lops = route_concentrate(cols, vl, jnp.int32(0))
-                rops = route_concentrate(cols, valid & ~gl, K - r_c)
-                lb = jnp.stack(lops[:NW], axis=1)
-                lp = _unpack_pay(lops[NW:NW + NPAY])
-                lo = lops[NW + NPAY]
-                rb = jnp.stack(rops[:NW], axis=1)
-                rp = _unpack_pay(rops[NW:NW + NPAY])
-                ro = rops[NW + NPAY]
-            else:
-                # stable in-chunk partition: one variadic sort moving
-                # all row data by a (side, position) key
-                side = jnp.where(vl, 0, jnp.where(valid, 1, 2))
-                key = side * K + iota_k
-                ops = lax.sort((key,) + cols, num_keys=1)
-                lb = jnp.stack(ops[1:1 + NW], axis=1)
-                lp = _unpack_pay(ops[1 + NW:1 + NW + NPAY])
-                lo = ops[1 + NW + NPAY]
-                # rights [l_c, l_c+r_c) rotated to the block END
-                s_r = lax.rem(l_c + r_c, jnp.asarray(K, jnp.int32))
-                rb, rp, ro = rot(lb, s_r), rot(lp, s_r), rot(lo, s_r)
-            # lefts [0, l_c) forward in place; rights packed backward
-            # from the window end in the other half
-            bins2 = write(bins2, src_base + l_off, lb, ml)
-            pay2 = write(pay2, src_base + l_off, lp, ml)
-            ord2 = write(ord2, src_base + l_off, lo, ml)
-            bins2 = write(bins2, o_r, rb, mr)
-            pay2 = write(pay2, o_r, rp, mr)
-            ord2 = write(ord2, o_r, ro, mr)
-            return (bins2, pay2, ord2, lazy_used,
-                    l_off + l_c, r_off + r_c, nlib, nib)
+        def make_body(CK, base_off):
+            """Partition-chunk body over CK rows starting at window
+            offset ``base_off + c*CK`` (base_off may be traced)."""
+            iota_c = jnp.arange(CK)
 
+            def body(c, carry):
+                (bins2, pay2, ord2, lazy_used,
+                 l_off, r_off, nlib, nib) = carry
+                off = base_off + c * CK
+                pos0 = src_base + off
+                blk_w = lax.dynamic_slice(bins2, (pos0, 0), (CK, NW))
+                blk_b = _unpack_bins(tuple(blk_w[:, i]
+                                           for i in range(NW)))
+                blk_p = lax.dynamic_slice(pay2, (pos0, 0), (CK, C))
+                blk_o = lax.dynamic_slice(ord2, (pos0,), (CK,))
+                blk_i = (blk_o & _IB_BIT) != 0
+                gl = chunk_goleft(blk_b, f, t, dl, isc, cm)
+                valid = iota_c < jnp.clip(cnt - off, 0, CK)
+                vl = valid & gl
+                l_c = jnp.sum(vl.astype(jnp.int32))
+                r_c = jnp.sum((valid & ~gl).astype(jnp.int32))
+                nlib += jnp.sum((vl & blk_i).astype(jnp.int32))
+                nib += jnp.sum((valid & blk_i).astype(jnp.int32))
+                if cegb_lazy:
+                    rows = (blk_o & ~_IB_BIT).astype(jnp.int32)
+                    # the split acquires feature f for every in-bag row
+                    # in the leaf (UpdateLeafBestSplits' InsertBitset
+                    # loop over the bagged partition)
+                    lazy_used = lazy_used.at[rows, f].max(valid & blk_i)
+                # the sort/route move the PACKED u32 word columns;
+                # children are written back packed too — bins only ever
+                # unpack transiently for goleft/histogram (bins2 stays
+                # u32-tiled, avoiding the u8 (4,1) sub-byte layout tax
+                # on every slice/RMW write)
+                cols = tuple(blk_w[:, i] for i in range(NW)) \
+                    + _pack_pay(blk_p) + (blk_o,)
+                ml = iota_c < l_c
+                o_r = dst_base + cnt - r_off - CK
+                mr = iota_c >= (CK - r_c)
+                if route:
+                    # two butterfly concentrations: lefts compact to the
+                    # block FRONT, rights directly to the block END (no
+                    # rotate needed — the offset is part of the route).
+                    lops = route_concentrate(cols, vl, jnp.int32(0))
+                    rops = route_concentrate(cols, valid & ~gl, CK - r_c)
+                    lb = jnp.stack(lops[:NW], axis=1)
+                    lp = _unpack_pay(lops[NW:NW + NPAY])
+                    lo = lops[NW + NPAY]
+                    rb = jnp.stack(rops[:NW], axis=1)
+                    rp = _unpack_pay(rops[NW:NW + NPAY])
+                    ro = rops[NW + NPAY]
+                else:
+                    # stable in-chunk partition: one variadic sort
+                    # moving all row data by a (side, position) key
+                    side = jnp.where(vl, 0, jnp.where(valid, 1, 2))
+                    key = side * CK + iota_c
+                    ops = lax.sort((key,) + cols, num_keys=1)
+                    lb = jnp.stack(ops[1:1 + NW], axis=1)
+                    lp = _unpack_pay(ops[1 + NW:1 + NW + NPAY])
+                    lo = ops[1 + NW + NPAY]
+                    # rights [l_c, l_c+r_c) rotated to the block END
+                    s_r = lax.rem(l_c + r_c, jnp.asarray(CK, jnp.int32))
+                    rb, rp, ro = rot(lb, s_r), rot(lp, s_r), rot(lo, s_r)
+                # lefts [0, l_c) forward in place; rights packed
+                # backward from the window end in the other half
+                bins2 = write(bins2, src_base + l_off, lb, ml)
+                pay2 = write(pay2, src_base + l_off, lp, ml)
+                ord2 = write(ord2, src_base + l_off, lo, ml)
+                bins2 = write(bins2, o_r, rb, mr)
+                pay2 = write(pay2, o_r, rp, mr)
+                ord2 = write(ord2, o_r, ro, mr)
+                return (bins2, pay2, ord2, lazy_used,
+                        l_off + l_c, r_off + r_c, nlib, nib)
+
+            return body
+
+        # the window's bulk streams in BK-row bodies (8x fewer
+        # serialized op chains than K-row bodies — the round-3 verdict's
+        # "kill the chunk serialization" item); the remainder streams in
+        # K-row bodies so small leaves never pay a BK-sized op
+        carry = (bins2, pay2, ord2, lazy_used, zero, zero, zero, zero)
+        if use_big:
+            nb_big = lax.div(cnt, jnp.asarray(BK, jnp.int32))
+            carry = lax.fori_loop(0, nb_big, make_body(BK, zero), carry)
+            tail_off = nb_big * BK
+        else:
+            tail_off = zero
+        carry = lax.fori_loop(0, window_chunks(cnt - tail_off),
+                              make_body(K, tail_off), carry)
         (bins2, pay2, ord2, lazy_used, n_left, _,
-         n_left_ib, n_ib) = lax.fori_loop(
-            0, window_chunks(cnt), body,
-            (bins2, pay2, ord2, lazy_used, zero, zero, zero, zero))
+         n_left_ib, n_ib) = carry
 
         # -- second streaming pass: histogram of the estimated-smaller
         # child over its NOW-CONTIGUOUS rows only. Histogram work drops
@@ -1076,29 +1181,44 @@ def _grow_compact_impl(cfg: GrowConfig,
         est_start = jnp.where(est_left_small, start, start + n_left)
         est_cnt = jnp.where(est_left_small, n_left, cnt - n_left)
         est_half = jnp.where(est_left_small, src, 1 - src)
-        est_base = est_half * SEG + K + est_start
+        est_base = est_half * SEG + PAD + est_start
 
-        def hist_body(c, carry):
-            hist, nu = carry
-            h, valid = chunk_hist(bins2, pay2, est_base, c, est_cnt)
-            hist = hist + h
-            if cegb_lazy:
-                blk_o = lax.dynamic_slice(ord2, (est_base + c * K,),
-                                          (K,))
-                blk_i = (blk_o & _IB_BIT) != 0
-                rows = (blk_o & ~_IB_BIT).astype(jnp.int32)
-                used_rows = jnp.take(lazy_used, rows, axis=0)  # [K, F]
-                # lazy_used already acquired feature f during the
-                # partition pass, so column f over-counts as "used" —
-                # harmless: the caller zeroes est_nu[f] regardless
-                # (do_split's est_nu_z)
-                nu = nu + jnp.sum((valid & blk_i)[:, None] & ~used_rows,
-                                  axis=0).astype(dtype)
-            return hist, nu
+        def make_hist_body(CK, base_off):
+            def hist_body(c, carry):
+                hist, nu = carry
+                off = base_off + c * CK
+                h, valid = chunk_hist(bins2, pay2, est_base + off,
+                                      est_cnt - off, CK)
+                hist = hist + h
+                if cegb_lazy:
+                    blk_o = lax.dynamic_slice(ord2, (est_base + off,),
+                                              (CK,))
+                    blk_i = (blk_o & _IB_BIT) != 0
+                    rows = (blk_o & ~_IB_BIT).astype(jnp.int32)
+                    used_rows = jnp.take(lazy_used, rows,
+                                         axis=0)          # [CK, F]
+                    # lazy_used already acquired feature f during the
+                    # partition pass, so column f over-counts as "used"
+                    # — harmless: the caller zeroes est_nu[f] regardless
+                    # (do_split's est_nu_z)
+                    nu = nu + jnp.sum(
+                        (valid & blk_i)[:, None] & ~used_rows,
+                        axis=0).astype(dtype)
+                return hist, nu
 
+            return hist_body
+
+        carry_h = (acc0, jnp.zeros((F,), dtype))
+        if use_big:
+            nh_big = lax.div(est_cnt, jnp.asarray(BK, jnp.int32))
+            carry_h = lax.fori_loop(0, nh_big, make_hist_body(BK, zero),
+                                    carry_h)
+            h_off = nh_big * BK
+        else:
+            h_off = zero
         est_hist, est_nu = lax.fori_loop(
-            0, window_chunks(est_cnt), hist_body,
-            (acc0, jnp.zeros((F,), dtype)))
+            0, window_chunks(est_cnt - h_off), make_hist_body(K, h_off),
+            carry_h)
 
         # exact global in-bag child counts replace the search-time
         # hessian-ratio estimates (SplitInner update_cnt,
@@ -1114,19 +1234,45 @@ def _grow_compact_impl(cfg: GrowConfig,
         histograms the same way, HistogramPool::Get on a miss).
         Out-of-bag rows carry zero payload (w folded into pay2), so no
         extra masking beyond the window tail is needed."""
-        src_base = src * SEG + K + start
-        acc0 = jnp.zeros((F, B, C), jnp.int32 if quant else dtype)
+        src_base = src * SEG + PAD + start
+        acc0 = jnp.zeros((FH, B, C), jnp.int32 if quant else dtype)
 
-        def body(c, acc):
-            return acc + chunk_hist(bins2, pay2, src_base, c, cnt)[0]
+        def make_body(CK, base_off):
+            def body(c, acc):
+                off = base_off + c * CK
+                return acc + chunk_hist(bins2, pay2, src_base + off,
+                                        cnt - off, CK)[0]
 
-        return hist_psum(lax.fori_loop(0, window_chunks(cnt), body,
-                                       acc0))
+            return body
+
+        if use_big:
+            nb = lax.div(cnt, jnp.asarray(BK, jnp.int32))
+            acc0 = lax.fori_loop(0, nb, make_body(BK, 0), acc0)
+            b_off = nb * BK
+        else:
+            b_off = jnp.asarray(0, jnp.int32)
+        return hist_psum(lax.fori_loop(0, window_chunks(cnt - b_off),
+                                       make_body(K, b_off), acc0))
+
+    # the streamed copy of the bin matrix lives PACKED: u32 words of
+    # pack_w bin columns each (u8 arrays carry a (4,1) sub-byte tiling
+    # that taxes every dynamic slice / masked RMW ~2-4x)
+    bins_pk = bins_rm if Fp == F \
+        else jnp.pad(bins_rm, ((0, 0), (0, Fp - F)))
+    if nibble_bins:
+        nib = bins_pk.reshape(n, NW, 8).astype(jnp.uint32)
+        bins_pk = sum(nib[:, :, k] << (4 * k) for k in range(8))
+    else:
+        bins_pk = lax.bitcast_convert_type(
+            bins_pk.reshape(n, NW, pack_w), jnp.uint32)    # [n, NW]
 
     # ---- root ----
+    # feature-parallel devices histogram only their own feature block
+    root_rows = _local_hist_rows(bins_pk, jnp.asarray(0, jnp.int32),
+                                 n) if fp else bins_rm
     total_c = psum(jnp.sum(inbag.astype(dtype)))
     if quant:
-        root_hist = hist_psum(hist_from_rows_int(bins_rm, gw2_q, B,
+        root_hist = hist_psum(hist_from_rows_int(root_rows, gw2_q, B,
                                                  hmethod))
         sums = hist_f(root_hist)[0].sum(axis=0)  # every row hits feature 0
         if vp:
@@ -1136,7 +1282,7 @@ def _grow_compact_impl(cfg: GrowConfig,
     else:
         total_g = psum(jnp.sum(gw2[:, 0]))
         total_h = psum(jnp.sum(gw2[:, 1]))
-        root_hist = hist_psum(hist_from_rows(bins_rm, gw2, B, hmethod,
+        root_hist = hist_psum(hist_from_rows(root_rows, gw2, B, hmethod,
                                              cfg.hist_precision))
 
     tree = _init_tree(L, B, dtype)
@@ -1197,7 +1343,7 @@ def _grow_compact_impl(cfg: GrowConfig,
             "hist_pool_slots is incompatible with CEGB / intermediate "
             "monotone / forced splits (their re-search walks every "
             "leaf's cached histogram); gbdt.py gates these")
-    hists = jnp.zeros((PS, F, B, 2),
+    hists = jnp.zeros((PS, FH, B, 2),
                       jnp.int32 if quant else dtype).at[0].set(root_hist)
     pool_state = ()
     if pooled:
@@ -1208,24 +1354,13 @@ def _grow_compact_impl(cfg: GrowConfig,
         )
     pay0 = gw2_q if quant \
         else (gw2.astype(jnp.bfloat16) if bf16_pay else gw2)
-    # the streamed copy of the bin matrix lives PACKED: u32 words of
-    # pack_w bin columns each (u8 arrays carry a (4,1) sub-byte tiling
-    # that taxes every dynamic slice / masked RMW ~2-4x)
-    bins_pk = bins_rm if Fp == F \
-        else jnp.pad(bins_rm, ((0, 0), (0, Fp - F)))
-    if nibble_bins:
-        nib = bins_pk.reshape(n, NW, 8).astype(jnp.uint32)
-        bins_pk = sum(nib[:, :, k] << (4 * k) for k in range(8))
-    else:
-        bins_pk = lax.bitcast_convert_type(
-            bins_pk.reshape(n, NW, pack_w), jnp.uint32)    # [n, NW]
     ord0 = jnp.arange(n, dtype=jnp.uint32) \
         | jnp.where(inbag, _IB_BIT, jnp.uint32(0))
     state = _CompactState(
         tree=tree, best=best, hists=hists,
-        bins2=jnp.pad(bins_pk, ((K, K + SEG), (0, 0))),
-        pay2=jnp.pad(pay0, ((K, K + SEG), (0, 0))),
-        ord2=jnp.pad(ord0, (K, K + SEG)),
+        bins2=jnp.pad(bins_pk, ((PAD, PAD + SEG), (0, 0))),
+        pay2=jnp.pad(pay0, ((PAD, PAD + SEG), (0, 0))),
+        ord2=jnp.pad(ord0, (PAD, PAD + SEG)),
         leaf_buf=jnp.zeros((L,), jnp.int32),
         leaf_begin=jnp.zeros((L,), jnp.int32),
         leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(n),
@@ -1533,7 +1668,21 @@ def _grow_compact_impl(cfg: GrowConfig,
         like the regular search (feature_histogram.hpp:528)."""
         totals = jnp.sum(hist[0], axis=0)          # every row hits feat 0
         tg, th = totals[0], totals[1]
-        h = hist[f]                                # [B, 2]
+        if fp:
+            # the forced feature's histogram lives on its owner device
+            # only; route it to everyone with one [B, 2] psum
+            own = _fp_owner(f) == dev_idx
+            lf = jnp.clip(f - f_start, 0, Fl - 1)
+            h_loc = lax.dynamic_index_in_dim(hist, lf, keepdims=False)
+            h = lax.psum(jnp.where(own, h_loc, 0.0), cfg.axis_name)
+        elif vp:
+            # voting keeps per-device caches local; a forced (feature,
+            # bin) needs the GLOBAL row — one [B, 2] psum
+            h = lax.psum(hist[f], cfg.axis_name)
+            tg = lax.psum(tg, cfg.axis_name)
+            th = lax.psum(th, cfg.axis_name)
+        else:
+            h = hist[f]                            # [B, 2]
         binsb = jnp.arange(B)
         nanb = feat_nan_bin[f]
         sel = (binsb <= t) & ~((binsb == nanb) & (nanb >= 0))
@@ -1603,8 +1752,8 @@ def _grow_compact_impl(cfg: GrowConfig,
                                      n, L)
     in_b1 = _leaf_values_at_positions(state.leaf_begin, state.leaf_count,
                                       state.leaf_buf, n) == 1
-    order_m = jnp.where(in_b1, state.ord2[SEG + K: SEG + K + n],
-                        state.ord2[K: K + n])
+    order_m = jnp.where(in_b1, state.ord2[SEG + PAD: SEG + PAD + n],
+                        state.ord2[PAD: PAD + n])
     order_ids = (order_m & ~_IB_BIT).astype(jnp.int32)
     row_leaf = _row_leaf_from_order(order_ids, leaf_of_pos)
     tree = state.tree

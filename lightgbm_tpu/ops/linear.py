@@ -25,6 +25,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .gather import gather_small
+
 __all__ = ["branch_features_per_leaf", "fit_leaf_linear",
            "linear_leaf_values"]
 
@@ -45,14 +47,17 @@ def linear_leaf_values(const: jnp.ndarray, coef: jnp.ndarray,
     """
     km = feats.shape[1]
     if km == 0:
-        return const[leaves]
-    fr = feats[leaves]                                     # [n, km]
-    act = jnp.arange(km)[None, :] < nfeat[leaves][:, None]
+        return gather_small(const, leaves)
+    # gather_small for every [n]-sized leaf lookup: TPU small-table
+    # gathers run ~1 elt/cycle (benchmarks/PROFILE.md)
+    fr = gather_small(feats, leaves)                       # [n, km]
+    act = jnp.arange(km)[None, :] < gather_small(nfeat, leaves)[:, None]
     x = jnp.take_along_axis(X, fr, axis=1)
     nanrow = jnp.any(jnp.isnan(x) & act, axis=1)
-    lin = const[leaves] + jnp.sum(
-        jnp.where(act, jnp.nan_to_num(x) * coef[leaves], 0.0), axis=1)
-    return jnp.where(nanrow, fallback[leaves], lin)
+    lin = gather_small(const, leaves) + jnp.sum(
+        jnp.where(act, jnp.nan_to_num(x) * gather_small(coef, leaves),
+                  0.0), axis=1)
+    return jnp.where(nanrow, gather_small(fallback, leaves), lin)
 
 
 def branch_features_per_leaf(split_feature: np.ndarray,
@@ -152,6 +157,7 @@ def fit_leaf_linear(raw: jnp.ndarray,
     const = jnp.where(ok_leaf, coef[:, -1], leaf_value)
     coeffs = jnp.where(ok_leaf[:, None] & active_col, coef[:, :kmax], 0.0)
 
-    pred_lin = const[row_leaf] + jnp.sum(coeffs[row_leaf] * x, axis=1)
-    pred = jnp.where(row_ok, pred_lin, leaf_value[row_leaf])
+    pred_lin = gather_small(const, row_leaf) + jnp.sum(
+        gather_small(coeffs, row_leaf) * x, axis=1)
+    pred = jnp.where(row_ok, pred_lin, gather_small(leaf_value, row_leaf))
     return const, coeffs, pred

@@ -88,7 +88,20 @@ def distributed_dataset(X, label=None, params: Optional[dict] = None,
         return ds
     from jax.experimental import multihost_utils
 
+    from ..basic import LightGBMError
     from ..ops.binning import bin_values
+
+    # process_allgather on unequal shard shapes fails with an opaque
+    # XLA shape error (or hangs); check the tiny n_local vector first
+    # and name the mismatched ranks
+    n_locals = np.asarray(multihost_utils.process_allgather(
+        np.asarray([ds.num_data()], np.int64))).reshape(-1)
+    if len(set(n_locals.tolist())) > 1:
+        detail = ", ".join(
+            f"rank {r}: {int(n)} rows" for r, n in enumerate(n_locals))
+        raise LightGBMError(
+            "distributed_dataset requires equal row counts per process "
+            f"(pad the last shard with weight-0 rows); got {detail}")
 
     ds.mappers = sync_bin_mappers(ds.mappers)
     # re-bin the local rows against the synchronized boundaries

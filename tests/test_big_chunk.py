@@ -1,0 +1,66 @@
+"""Big-chunk bulk batching (GrowConfig.big_chunk): the partition
+streams floor(cnt/BK) BK-row bodies then K-row tail bodies per window.
+Must be semantically identical to the K-only loop.
+
+With quantized gradients the histograms are exact int32, so the tree
+must be BIT-identical regardless of chunking. In float mode only the
+within-window row ORDER (and hence float summation order) may differ;
+trees must still agree structurally on well-separated data.
+"""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from conftest import make_synthetic_binary
+
+
+def _train(X, y, big, extra=None):
+    params = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+              "chunk_rows": 256, "big_chunk_rows": big,
+              "min_data_in_leaf": 5}
+    params.update(extra or {})
+    return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=5)
+
+
+def test_big_chunk_quantized_bit_identical():
+    X, y = make_synthetic_binary(n=6000, f=8, seed=3)
+    extra = {"use_quantized_grad": True, "stochastic_rounding": False}
+    b0 = _train(X, y, 0, extra)
+    b1 = _train(X, y, 1024, extra)
+    for t0, t1 in zip(b0._models, b1._models):
+        np.testing.assert_array_equal(t0.split_feature, t1.split_feature)
+        np.testing.assert_array_equal(t0.threshold, t1.threshold)
+        np.testing.assert_array_equal(t0.leaf_value, t1.leaf_value)
+    np.testing.assert_array_equal(b0.predict(X), b1.predict(X))
+
+
+def test_big_chunk_float_structurally_equal():
+    X, y = make_synthetic_binary(n=6000, f=8, seed=4)
+    b0 = _train(X, y, 0)
+    b1 = _train(X, y, 1024)
+    for t0, t1 in zip(b0._models, b1._models):
+        np.testing.assert_array_equal(t0.split_feature, t1.split_feature)
+        np.testing.assert_array_equal(t0.threshold, t1.threshold)
+    np.testing.assert_allclose(b0.predict(X), b1.predict(X), rtol=2e-5,
+                               atol=1e-7)
+
+
+def test_big_chunk_with_bagging_and_cat():
+    rs = np.random.RandomState(9)
+    n = 5000
+    Xn, y = make_synthetic_binary(n=n, f=6, seed=9)
+    cat = rs.randint(0, 12, size=(n, 1)).astype(np.float64)
+    y = np.where((cat[:, 0] > 6) ^ (y > 0), 1.0, 0.0)
+    X = np.hstack([Xn, cat])
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "chunk_rows": 256, "big_chunk_rows": 1024,
+              "bagging_fraction": 0.7, "bagging_freq": 1, "seed": 5,
+              "use_quantized_grad": True, "stochastic_rounding": False}
+    ds = lgb.Dataset(X, label=y, categorical_feature=[6])
+    b1 = lgb.train(params, ds, num_boost_round=5)
+    params0 = dict(params, big_chunk_rows=0)
+    ds0 = lgb.Dataset(X, label=y, categorical_feature=[6])
+    b0 = lgb.train(params0, ds0, num_boost_round=5)
+    for t0, t1 in zip(b0._models, b1._models):
+        np.testing.assert_array_equal(t0.split_feature, t1.split_feature)
+    np.testing.assert_array_equal(b0.predict(X), b1.predict(X))

@@ -96,3 +96,25 @@ def test_feature_parallel_with_bagging_and_categoricals():
                      lgb.Dataset(X, label=y, categorical_feature=[0]),
                      num_boost_round=5)
     assert _trees_equal(serial, feat)
+
+
+@needs_mesh
+def test_feature_parallel_unaligned_word_blocks():
+    """D does not divide NW and a tail device's clamped window holds
+    LIVE features (round-4 review regression): F=34 u8 features -> 9
+    packed words, NWl=2 over 8 devices, so device 4's window clamps to
+    words [7, 9) while it owns features [32, 34). The signal feature 32
+    lives exactly there; feature-parallel must still find it."""
+    rs = np.random.RandomState(13)
+    n, f = 3000, 34
+    X = rs.randn(n, f)
+    y = ((X[:, 32] + 0.3 * X[:, 5]) > 0).astype(float)
+    serial = _train("serial", X, y)
+    feat = _train("feature", X, y)
+    assert _trees_equal(serial, feat)
+    np.testing.assert_allclose(serial.predict(X[:100]),
+                               feat.predict(X[:100]),
+                               rtol=1e-5, atol=1e-7)
+    # the signal feature must actually be used
+    assert any(32 in t.split_feature[:t.num_nodes]
+               for t in serial._models)
