@@ -37,6 +37,9 @@ HIGGS_ROWS = 10_500_000
 # record is data, not a traceback.
 PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", 10))
 PROBE_BACKOFF_S = float(os.environ.get("BENCH_PROBE_BACKOFF", 30.0))
+# a half-dead tunnel can make backend init HANG rather than raise;
+# each probe attempt runs in a subprocess bounded by this timeout
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
 # last full-scale number measured by the builder on a real chip
 # (10.5M x 28, 255 leaves/bins; see benchmarks/PROFILE.md)
 LAST_MEASURED = {"value": 1.12, "unit": "iters/sec",
@@ -54,28 +57,54 @@ def _git_head():
 
 
 def _probe_backend():
-    """Wait for a usable JAX backend; returns jax or raises last error."""
+    """Wait for a usable JAX backend; returns jax or raises last error.
+
+    The probe runs in a SUBPROCESS with a hard timeout: a dead tunnel
+    can make backend init either raise (caught) or HANG in native code
+    holding the GIL (where in-process SIGALRM never fires — observed
+    round 4). The parent only imports jax once a probe succeeded."""
     last = None
     for attempt in range(PROBE_RETRIES):
         try:
-            import jax
-            jax.devices()  # forces backend init
-            return jax
-        except Exception as e:  # backend init failure (tunnel down)
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); print('BENCH_PROBE_OK')"],
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S)
+            if r.returncode == 0 and "BENCH_PROBE_OK" in r.stdout:
+                try:
+                    import jax
+                    jax.devices()
+                    return jax
+                except Exception as e:
+                    # the tunnel died in the probe->init window; jax
+                    # caches the failed backend init in-process, so a
+                    # retry needs a fresh interpreter: re-exec with a
+                    # decremented budget
+                    sys.stderr.write(
+                        f"bench: parent backend init failed after a "
+                        f"successful probe: {e}\n")
+                    if attempt + 1 < PROBE_RETRIES:
+                        time.sleep(PROBE_BACKOFF_S)
+                        env = dict(os.environ)
+                        env["BENCH_PROBE_RETRIES"] = str(
+                            PROBE_RETRIES - attempt - 1)
+                        os.execve(sys.executable,
+                                  [sys.executable] + sys.argv, env)
+                    raise
+            tail = (r.stderr or r.stdout).strip().splitlines()
+            last = RuntimeError(tail[-1] if tail else
+                                f"probe rc={r.returncode}")
+        except subprocess.TimeoutExpired:
+            last = TimeoutError(
+                f"backend init hung > {PROBE_TIMEOUT_S}s "
+                "(tunnel half-dead)")
+        except Exception as e:
             last = e
-            # jax caches a failed backend init in-process; a retry needs
-            # a fresh interpreter. Sleep, then re-exec ourselves with a
-            # decremented retry budget.
-            sys.stderr.write(
-                f"bench: backend probe {attempt + 1}/{PROBE_RETRIES} "
-                f"failed: {e}\n")
-            if attempt + 1 < PROBE_RETRIES:
-                time.sleep(PROBE_BACKOFF_S)
-                env = dict(os.environ)
-                env["BENCH_PROBE_RETRIES"] = str(
-                    PROBE_RETRIES - attempt - 1)
-                os.execve(sys.executable,
-                          [sys.executable] + sys.argv, env)
+        sys.stderr.write(
+            f"bench: backend probe {attempt + 1}/{PROBE_RETRIES} "
+            f"failed: {last}\n")
+        if attempt + 1 < PROBE_RETRIES:
+            time.sleep(PROBE_BACKOFF_S)
     raise last
 
 

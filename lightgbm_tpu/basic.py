@@ -110,6 +110,138 @@ def _load_text_file(path: str, cfg: Config
     return X, y, weight, group
 
 
+def _two_round_load(path: str, cfg: Config, cat_idx_set,
+                    feature_name):
+    """Two-round / out-of-core text loading (``two_round=true``;
+    dataset_loader.cpp:299,960 LoadFromFile's two-pass path).
+
+    Round 1 streams the file once: counts rows and reservoir-samples up
+    to ``bin_construct_sample_cnt`` raw lines; BinMappers are built from
+    the sample only (the reference's SampleTextDataFromFile +
+    ConstructBinMappersFromTextData). Round 2 streams again in bounded
+    chunks, parsing and binning each chunk straight into the
+    preallocated u8/u16 matrix — the raw float matrix is NEVER
+    materialized, so peak memory is the BINNED matrix (1-2 bytes/value)
+    plus one chunk, not 8 bytes/value.
+
+    Returns (bins [n, F_used], mappers, used, full_mappers, n, F,
+    label, weight, group).
+    """
+    from .ops.binning import BinType, bin_values, find_bin
+
+    with open(path, "r") as f:
+        first = f.readline().strip()
+    sep = "\t" if "\t" in first else ("," if "," in first else None)
+    tokens = first.replace(",", " ").replace("\t", " ").split()
+    if any(":" in t for t in tokens[1:]):
+        return None  # libsvm rows are ragged; eager loader handles them
+    header = bool(cfg.header)
+    label_col = 0
+    lc = str(cfg.label_column)
+    if lc and not lc.startswith("name:"):
+        label_col = int(lc)
+
+    # ---- round 1: count + reservoir sample ----
+    rs = np.random.RandomState(cfg.data_random_seed)
+    cap = max(int(cfg.bin_construct_sample_cnt), 2)
+    sample_lines: List[str] = []
+    n = 0
+    with open(path, "r") as f:
+        if header:
+            f.readline()
+        for line in f:
+            if not line.strip():
+                continue
+            if n < cap:
+                sample_lines.append(line)
+            else:
+                j = int(rs.randint(0, n + 1))
+                if j < cap:
+                    sample_lines[j] = line
+            n += 1
+    if n == 0:
+        raise LightGBMError(f"empty data file {path}")
+
+    def parse_lines(lines):
+        try:
+            # np.loadtxt's C tokenizer: fast and allocation-light (the
+            # python-object row lists genfromtxt builds would dominate
+            # the loader's peak memory)
+            arr = np.loadtxt(lines, delimiter=sep, ndmin=2)
+        except ValueError:
+            arr = np.genfromtxt(lines, delimiter=sep)
+            if arr.ndim == 1:
+                arr = arr[None, :] if len(lines) == 1 else arr[:, None]
+        return arr
+
+    sample = parse_lines(sample_lines)
+    del sample_lines
+    F = sample.shape[1] - 1
+    Xs = np.delete(sample, label_col, axis=1)
+    del sample
+
+    # ---- mappers from the sample only ----
+    full_mappers = []
+    for j in range(F):
+        mb = cfg.max_bin
+        if cfg.max_bin_by_feature and j < len(cfg.max_bin_by_feature):
+            mb = cfg.max_bin_by_feature[j]
+        m = find_bin(
+            Xs[:, j], mb,
+            min_data_in_bin=cfg.min_data_in_bin,
+            bin_type=(BinType.CATEGORICAL if j in cat_idx_set
+                      else BinType.NUMERICAL),
+            use_missing=cfg.use_missing,
+            zero_as_missing=cfg.zero_as_missing)
+        full_mappers.append(m)
+    del Xs
+    used = [j for j, m in enumerate(full_mappers) if not m.is_trivial]
+    mappers = [full_mappers[j] for j in used]
+    max_bins = max((m.num_bins for m in mappers), default=2)
+    bdtype = np.uint8 if max_bins <= 256 else np.uint16
+
+    # ---- round 2: chunked parse -> bin in place ----
+    CHUNK = 16384
+    bins = np.zeros((n, len(used)), bdtype)
+    label = np.zeros(n, np.float64)
+    row = 0
+    with open(path, "r") as f:
+        if header:
+            f.readline()
+        buf: List[str] = []
+        for line in f:
+            if not line.strip():
+                continue
+            buf.append(line)
+            if len(buf) == CHUNK:
+                arr = parse_lines(buf)
+                label[row:row + len(buf)] = arr[:, label_col]
+                Xc = np.delete(arr, label_col, axis=1)
+                bins[row:row + len(buf)] = bin_values(
+                    [Xc[:, j] for j in used], mappers, bdtype)
+                row += len(buf)
+                buf = []
+        if buf:
+            arr = parse_lines(buf)
+            label[row:row + len(buf)] = arr[:, label_col]
+            Xc = np.delete(arr, label_col, axis=1)
+            bins[row:row + len(buf)] = bin_values(
+                [Xc[:, j] for j in used], mappers, bdtype)
+            row += len(buf)
+    if row != n:
+        raise LightGBMError(
+            f"two_round: second pass read {row} rows, first pass {n}")
+
+    weight = None
+    group = None
+    if os.path.exists(path + ".weight"):
+        weight = np.loadtxt(path + ".weight")
+    if os.path.exists(path + ".query"):
+        group = np.loadtxt(path + ".query").astype(np.int64)
+    return (bins, mappers, np.asarray(used, np.int32), full_mappers,
+            n, F, label, weight, group)
+
+
 def _extract_pandas(data, categorical_feature):
     """Pandas ingestion: category dtypes -> integer codes (the
     pandas_categorical path of basic.py _data_from_pandas)."""
@@ -423,6 +555,30 @@ class Dataset:
         if isinstance(data, (str, Path)) and self._is_binary_file(str(data)):
             return self._construct_from_binary(str(data))
         if isinstance(data, (str, Path)):
+            if cfg.two_round and self.reference is None:
+                cat_set = set()
+                cat_ok = True
+                for src in (self.categorical_feature,
+                            cfg.categorical_feature):
+                    if src in ("auto", "", None):
+                        continue
+                    if isinstance(src, str):
+                        src = [c for c in src.split(",") if c]
+                    if isinstance(src, (list, tuple)):
+                        try:
+                            cat_set |= {int(c) for c in src}
+                            continue
+                        except (TypeError, ValueError):
+                            pass
+                    # name-based spec needs the parsed header; the
+                    # eager loader resolves it
+                    cat_ok = False
+                out = _two_round_load(str(data), cfg, cat_set,
+                                      feature_name) if cat_ok else None
+                if out is not None:
+                    return self._finish_two_round(cfg, out, label,
+                                                  weight, group,
+                                                  cat_set)
             X, y, w, q = _load_text_file(str(data), cfg)
             if label is None:
                 label = y
@@ -557,6 +713,51 @@ class Dataset:
         if self.init_score is not None:
             self.init_score = np.asarray(self.init_score,
                                          np.float64)
+        self._handle = True
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def _finish_two_round(self, cfg, out, label, weight, group,
+                          cat_set) -> "Dataset":
+        """Install the out-of-core loader's pre-binned result (the tail
+        of construct() without a raw float matrix ever existing)."""
+        (bins, mappers, used, full_mappers, n, F, y, w, q) = out
+        if label is not None:
+            y = np.asarray(label, np.float64).ravel()
+        if weight is None and w is not None:
+            weight = w
+        if group is None and q is not None:
+            group = q
+        if len(y) != n:
+            raise LightGBMError(
+                f"Length of label ({len(y)}) != number of rows ({n})")
+        if cfg.linear_tree:
+            raise LightGBMError(
+                "two_round loading cannot retain raw data for "
+                "linear_tree (the reference's two-pass loader has the "
+                "same restriction on raw-data consumers)")
+        self._n, self._F_total = n, F
+        self._feature_names = [f"Column_{i}" for i in range(F)]
+        self._cat_idx = set(cat_set)
+        self.mappers = mappers
+        self._used_features = used
+        self._full_mappers = full_mappers
+        self._bins = bins
+        self._F = len(mappers)
+        self._raw_numeric = None
+        self.label = y
+        self.weight = None if weight is None else \
+            np.asarray(weight, np.float64).ravel()
+        if group is not None:
+            g = np.asarray(group, np.int64).ravel()
+            self._query_boundaries = np.concatenate(
+                [[0], np.cumsum(g)]).astype(np.int64)
+            if self._query_boundaries[-1] != n:
+                raise LightGBMError(
+                    "Sum of group sizes != number of rows")
+        if self.init_score is not None:
+            self.init_score = np.asarray(self.init_score, np.float64)
         self._handle = True
         if self.free_raw_data:
             self.data = None

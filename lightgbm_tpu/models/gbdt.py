@@ -185,12 +185,6 @@ class GBDTBooster:
                 f"(~{self.n * cfg.num_leaves / 1e9:.1f}B row-visits "
                 "here). Use grower=compact (the default) for data of "
                 "this size.")
-        if self.monotone is not None \
-                and cfg.monotone_constraints_method == "advanced":
-            raise ValueError(
-                "monotone_constraints_method=advanced is not implemented; "
-                "use basic or intermediate "
-                "(AdvancedLeafConstraints, monotone_constraints.hpp:858)")
         self.grow_cfg_extra = {}
         self.grow_cfg = GrowConfig(
             num_leaves=cfg.num_leaves,
@@ -254,7 +248,9 @@ class GBDTBooster:
                     jnp.asarray(binfo.is_direct),
                     jnp.asarray(binfo.member_at),
                     jnp.asarray(binfo.tloc_at),
-                    jnp.asarray(binfo.end_at))
+                    jnp.asarray(binfo.end_at),
+                    jnp.asarray(binfo.nanpos_at),
+                    jnp.asarray(binfo.nan_at))
                 self.grow_cfg = self.grow_cfg._replace(
                     bundled=True, num_bins=binfo.num_positions)
         # only ONE training matrix ever reaches HBM: bundled when EFB
@@ -264,11 +260,11 @@ class GBDTBooster:
 
         # -- histogram cache budget (HistogramPool analog;
         # histogram_pool_size in MB, -1 = unlimited like the reference,
-        # config.h:301). Slots sized by the post-bundle column count;
-        # incompatible features keep the full cache. --
-        if cfg.histogram_pool_size > 0 and grower == "compact" \
-                and not self.cegb_enabled and self.forced is None \
-                and cfg.monotone_constraints_method != "intermediate":
+        # config.h:301). Slots sized by the post-bundle column count.
+        # CEGB / intermediate monotone / forced splits are served by the
+        # pooled re-search (recompute-on-miss), like the reference pool
+        # serves all consumers. --
+        if cfg.histogram_pool_size > 0 and grower == "compact":
             ncols = int(self.bins_T.shape[0])
             per_leaf = ncols * self.grow_cfg.num_bins * 2 * 4
             slots = int(cfg.histogram_pool_size * 2 ** 20 // per_leaf)

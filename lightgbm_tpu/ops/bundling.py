@@ -18,9 +18,20 @@ uses when every member's most-frequent bin is bin 0):
   ``leaf_total - sum(member range)`` — the FixHistogram /
   most_freq_bin reconstruction (dataset.h:760) reborn as pure algebra.
 
-Eligibility: numerical features whose zero maps to bin 0 and that carry
-no NaN bin. Bundling is built host-side once at Dataset construction
-(numpy), exactly like the reference's loader-time grouping.
+Eligibility: numerical features whose zero maps to bin 0 (the shared
+default). Members MAY carry a NaN bin: its mapped position is excluded
+from threshold scans and routed by the learned default direction, just
+like the plain search's dual missing-direction scan. Merges tolerate up
+to ``total_sample_cnt / 10000`` conflicting rows per bundle — the
+reference's single_val_max_conflict_cnt budget (src/io/dataset.cpp:115)
+— so near-exclusive features (Allstate/Bosch-class sparse one-hots)
+still bundle; at zero conflicts the bundled model stays EXACTLY the
+unbundled model. Bundling is built host-side once at Dataset
+construction (numpy), exactly like the reference's loader-time
+grouping. Categorical members remain excluded: their membership-mask
+splits would need per-member one-hot semantics in remapped bundle
+space, and the reference's accuracy story for EFB is about sparse
+numerical one-hots.
 """
 
 from __future__ import annotations
@@ -31,7 +42,10 @@ import numpy as np
 
 __all__ = ["BundleInfo", "build_bundles"]
 
-
+# per-bundle conflict budget as a fraction of sampled rows
+# (single_val_max_conflict_cnt = total_sample_cnt / 10000,
+# src/io/dataset.cpp:115)
+MAX_CONFLICT_FRACTION = 1.0 / 10000
 class BundleInfo(NamedTuple):
     """Host-side bundling result handed to the grower."""
     groups: List[List[int]]       # member feature ids per bundle
@@ -47,18 +61,28 @@ class BundleInfo(NamedTuple):
                                   #   threshold bin
     end_at: np.ndarray            # [G, B] i32 — flat [G*B] index of the
                                   #   member's last position (range end)
+    nanpos_at: np.ndarray         # [G, B] i32 — flat [G*B] index of the
+                                  #   member-at-position's NaN-bin
+                                  #   position (-1: member has none)
+    nan_at: np.ndarray            # [G, B] bool — position IS a member's
+                                  #   NaN bin (excluded from scans)
 
 
 def _eligible(mappers, bins: np.ndarray) -> np.ndarray:
-    """Features that may enter a multi-member bundle: numerical, no
-    missing bin, and zero maps to bin 0 (the shared default)."""
+    """Features that may enter a multi-member bundle: numerical with
+    zero mapping to bin 0 (the shared default); a NaN bin is allowed
+    (handled by the dual-direction scan + nanpos/nan_at plumbing).
+    MissingType.ZERO members stay excluded: their missing bin IS the
+    shared default-0 position, which the per-member NaN-position
+    algebra (nan bin = last bin) cannot represent — they remain direct
+    singletons with the plain dual scan."""
     from .binning import BinType, MissingType
     F = bins.shape[1]
     ok = np.zeros(F, bool)
     for j, m in enumerate(mappers):
         if m.bin_type != BinType.NUMERICAL:
             continue
-        if m.missing_type != MissingType.NONE:
+        if m.missing_type == MissingType.ZERO:
             continue
         if m.num_bins < 2:
             continue
@@ -100,29 +124,46 @@ def build_bundles(bins: np.ndarray, mappers,
     eligible = _eligible(mappers, bins) & (density <= 1 - sparse_threshold)
 
     nbins = np.array([m.num_bins for m in mappers], np.int64)
+    S = sample.shape[0]
+    # per-bundle conflict budget (single_val_max_conflict_cnt,
+    # src/io/dataset.cpp:115): rows where two members are both nonzero
+    # are tolerated up to this count — the later member's value wins in
+    # the shared column, a bounded approximation the reference accepts
+    conflict_budget = int(S * MAX_CONFLICT_FRACTION)
     order = np.argsort(-nz.sum(axis=0))     # dense first (reference)
     groups: List[List[int]] = []
     group_nz: List[np.ndarray] = []         # aggregated nonzero masks
     group_pos: List[int] = []               # occupied positions (1 + ...)
+    group_conf: List[int] = []              # conflicts spent so far
     for j in order:
         if not eligible[j]:
             continue
         placed = False
         width = int(nbins[j]) - 1
+        # first-fit over ALL groups. The reference samples at most
+        # max_search_group=100 random candidates (dataset.cpp:113) as a
+        # 100K+-feature scale heuristic, but sampling can miss the one
+        # compatible group and shatter the packing (measured: a 160-
+        # block one-hot matrix went 186 -> 1853 columns); the exact
+        # scan is cheap because eligibility already filters to sparse
+        # features and the hit is found early for block-sparse data.
         for gi in range(len(groups)):
             if group_pos[gi] + width > max_positions:
                 continue
-            if np.any(group_nz[gi] & nz[:, j]):
-                continue                    # conflict: keep exclusive
+            cnt = int(np.sum(group_nz[gi] & nz[:, j]))
+            if group_conf[gi] + cnt > conflict_budget:
+                continue                    # over the conflict budget
             groups[gi].append(int(j))
             group_nz[gi] |= nz[:, j]
             group_pos[gi] += width
+            group_conf[gi] += cnt
             placed = True
             break
         if not placed and width + 1 <= max_positions:
             groups.append([int(j)])
             group_nz.append(nz[:, j].copy())
             group_pos.append(1 + width)
+            group_conf.append(0)
 
     multi = [g for g in groups if len(g) > 1]
     if not multi:
@@ -168,9 +209,15 @@ def build_bundles(bins: np.ndarray, mappers,
                 col[sel] = offset_of[j] + bj[sel] - 1
             out[:, gi] = col.astype(dtype)
 
+    from .binning import MissingType
+    nanb = np.array([int(nbins[j]) - 1
+                     if mappers[j].missing_type == MissingType.NAN
+                     else -1 for j in range(F)], np.int64)
     member_at = np.full((G, B), -1, np.int32)
     tloc_at = np.zeros((G, B), np.int32)
     end_at = np.zeros((G, B), np.int32)
+    nanpos_at = np.full((G, B), -1, np.int32)
+    nan_at = np.zeros((G, B), bool)
     for gi, g in enumerate(final_groups):
         if len(g) == 1:
             j = g[0]
@@ -178,6 +225,9 @@ def build_bundles(bins: np.ndarray, mappers,
             member_at[gi, :nb] = j
             tloc_at[gi, :nb] = np.arange(nb)
             end_at[gi, :nb] = gi * B + nb - 1
+            if nanb[j] >= 0:
+                nanpos_at[gi, :nb] = gi * B + int(nanb[j])
+                nan_at[gi, int(nanb[j])] = True
         else:
             for j in g:
                 off = int(offset_of[j])
@@ -191,5 +241,11 @@ def build_bundles(bins: np.ndarray, mappers,
                 member_at[gi, lo:hi + 1] = j
                 tloc_at[gi, lo:hi + 1] = np.arange(nb)
                 end_at[gi, lo:hi + 1] = gi * B + off + nb - 2
+                if nanb[j] >= 0:
+                    # the member's NaN bin maps to its LAST position
+                    p_nan = off + int(nanb[j]) - 1
+                    nanpos_at[gi, lo:hi + 1] = gi * B + p_nan
+                    nan_at[gi, p_nan] = True
     return BundleInfo(final_groups, bundle_of, offset_of, is_direct,
-                      out, B, member_at, tloc_at, end_at)
+                      out, B, member_at, tloc_at, end_at,
+                      nanpos_at, nan_at)

@@ -123,9 +123,9 @@ class GrowConfig(NamedTuple):
     # the full [L, F, B, 2] per-leaf cache HBM-resident; a positive
     # value caps the cache at that many leaf slots — evicted leaves'
     # histograms are recomputed from their (physically contiguous)
-    # row window on demand. Incompatible with the stored-candidate
-    # re-search paths (CEGB, intermediate monotone, forced splits);
-    # gbdt.py gates those combinations.
+    # row window on demand, including inside the stored-candidate
+    # re-search paths (CEGB / intermediate monotone / forced splits),
+    # which walk leaves serving each hist from slot or recompute.
     hist_pool_slots: int = 0
     # in-chunk stable partition primitive (compact grower):
     # "sort"  — one variadic lax.sort on a (side, position) key.
@@ -636,7 +636,12 @@ def _grow_compact_impl(cfg: GrowConfig,
         return lax.psum(x, cfg.axis_name)
 
     has_mono = monotone_constraints is not None
-    intermediate = has_mono and cfg.monotone_method == "intermediate"
+    # "advanced" (monotone precise mode) keeps intermediate's every-split
+    # re-search machinery and replaces the scalar output bounds with
+    # per-(feature, threshold) bounds computed from leaf boxes
+    advanced = has_mono and cfg.monotone_method == "advanced"
+    intermediate = has_mono and cfg.monotone_method in ("intermediate",
+                                                        "advanced")
     use_bynode = cfg.bynode < 1.0 and node_key is not None
     smoothing = p.path_smooth > 0.0
 
@@ -650,7 +655,7 @@ def _grow_compact_impl(cfg: GrowConfig,
                 "EFB bundling currently supports plain single-device "
                 "training only (gbdt.py gates the combinations)")
         (bundle_of, offset_of, bundle_is_direct, member_at, tloc_at,
-         end_at) = bundle_arrays
+         end_at, bundle_nanpos, bundle_nan_at) = bundle_arrays
 
     def _fp_combine(r: SplitResult) -> SplitResult:
         """SyncUpGlobalBestSplit (parallel_tree_learner.h:209-232):
@@ -684,7 +689,8 @@ def _grow_compact_impl(cfg: GrowConfig,
             return find_best_split_bundled(hist, sg, sh, sc, member_at,
                                            tloc_at, end_at,
                                            bundle_is_direct,
-                                           feat_nan_bin, fmask, p)
+                                           bundle_nanpos, bundle_nan_at,
+                                           fmask, p)
         if fp:
             # disjoint feature ownership over word-aligned windows: the
             # device's histogram covers ONLY its own Fl columns (built
@@ -707,6 +713,17 @@ def _grow_compact_impl(cfg: GrowConfig,
                 return lax.dynamic_slice(v, (f_start,), (Fl,))
 
             owned = _fp_owner(f_start + jnp.arange(Fl)) == dev_idx
+            if bounds is not None and len(bounds) == 6:
+                # advanced monotone: slice the per-[F, B] bound arrays
+                # to this device's feature window (pad rows are masked
+                # off by `owned` anyway)
+                def bsl(b):
+                    if Fp > F:
+                        b = jnp.concatenate(
+                            [b, jnp.zeros((Fp - F, B), b.dtype)])
+                    return lax.dynamic_slice(b, (f_start, 0), (Fl, B))
+
+                bounds = tuple(bsl(b) for b in bounds[:4]) + bounds[4:]
             r = find_best_split(hist, sg, sh, sc,
                                 lsl(feat_num_bins, 1),
                                 lsl(feat_nan_bin, -1),
@@ -795,6 +812,89 @@ def _grow_compact_impl(cfg: GrowConfig,
         contains = ~jnp.any(branch_set[None, :] & ~interaction_groups,
                             axis=1)                       # [G]
         return jnp.any(interaction_groups & contains[:, None], axis=0)
+
+    def advanced_bounds(box_lo, box_hi, values, num_leaves_, bl, bh):
+        """Per-(feature, threshold) monotone output bounds for the
+        children of a split of the leaf whose bin-space box is
+        [bl, bh) — AdvancedLeafConstraints ("monotone precise mode",
+        monotone_constraints.hpp:858) re-expressed as box algebra.
+
+        The reference walks up the leaf's path and recursively down
+        each monotone ancestor's opposing subtree, collecting leaf
+        outputs into per-threshold segment lists
+        (GoDownToFindConstrainingLeaves / UpdateConstraints). The
+        constraining set it visits is exactly: leaves whose boxes
+        OVERLAP the searched leaf's box in every feature except one
+        monotone feature m, where they are disjoint-ordered (the LCA
+        split on m is the monotone ancestor; categorical splits leave
+        both children's boxes equal to the parent's, reproducing the
+        reference's keep-going-both-ways treatment of categorical
+        nodes). So, tensorized over the CURRENT leaves:
+        - route m != j (t-refined only through the child's j-interval
+          overlap): ordered-in-m leaves bound the child wherever their
+          j-interval overlaps the child's;
+        - route m == j: leaves ordered in j against the CHILD interval
+          ([lo_j, t+1) left / [t+1, hi_j) right) bound it directly.
+        Upper bounds come from increasing-feature-above or
+        decreasing-feature-below leaves (min of their outputs); lower
+        bounds are symmetric (max).
+
+        Returns the 6-tuple consumed by split_bounds_lrc: per-[F, B]
+        (lmin_l, lmax_l, lmin_r, lmax_r) plus scalar fallbacks
+        (smin, smax) for categorical candidates (a categorical split
+        leaves both children's boxes equal to the parent's, so only the
+        t-independent route applies)."""
+        inf_ = jnp.asarray(jnp.inf, dtype)
+        act = jnp.arange(L) < num_leaves_                  # [L]
+        ov = (box_lo < bh[None, :]) & (box_hi > bl[None, :])   # [L, F]
+        nonov = (~ov).astype(jnp.int32)
+        cnt_no = jnp.sum(nonov, axis=1)                    # [L]
+        only_m = (cnt_no[:, None] - nonov) == 0            # [L, F]
+        above = box_lo >= bh[None, :]                      # [L, F]
+        below = box_hi <= bl[None, :]
+        mc_i = monotone_constraints.astype(jnp.int32)
+        inc = (mc_i > 0)[None, :]
+        dec = (mc_i < 0)[None, :]
+        up_any = jnp.any(only_m & ((inc & above) | (dec & below)),
+                         axis=1) & act                     # [L]
+        dn_any = jnp.any(only_m & ((inc & below) | (dec & above)),
+                         axis=1) & act
+        t = jnp.arange(B)[None, None, :]                   # thresholds
+        # overlap of each leaf's j-interval with the child's:
+        # left child [bl_j, t+1), right child [t+1, bh_j)
+        ovl_l = (box_lo[:, :, None] <= t) \
+            & (box_hi[:, :, None] > bl[None, :, None])     # [L, F, B]
+        ovl_r = (box_lo[:, :, None] < bh[None, :, None]) \
+            & (box_hi[:, :, None] > t + 1)
+        # route m == j: ordering against the child's own j-interval
+        oj = (only_m & act[:, None])[:, :, None]           # [L, F, 1]
+        above_l = box_lo[:, :, None] >= t + 1              # [L, F, B]
+        below_r = box_hi[:, :, None] <= t + 1
+        up_l2 = oj & ((inc[:, :, None] & above_l)
+                      | (dec & below)[:, :, None])
+        dn_l2 = oj & ((inc & below)[:, :, None]
+                      | (dec[:, :, None] & above_l))
+        up_r2 = oj & ((inc & above)[:, :, None]
+                      | (dec[:, :, None] & below_r))
+        dn_r2 = oj & ((inc[:, :, None] & below_r)
+                      | (dec & above)[:, :, None])
+        v = values[:, None, None]
+
+        def vmin(mask):
+            return jnp.min(jnp.where(mask, v, inf_), axis=0)
+
+        def vmax(mask):
+            return jnp.max(jnp.where(mask, v, -inf_), axis=0)
+
+        u_any = up_any[:, None, None]
+        d_any = dn_any[:, None, None]
+        lmax_l = vmin((u_any & ovl_l) | up_l2)             # [F, B]
+        lmin_l = vmax((d_any & ovl_l) | dn_l2)
+        lmax_r = vmin((u_any & ovl_r) | up_r2)
+        lmin_r = vmax((d_any & ovl_r) | dn_r2)
+        smax = jnp.min(jnp.where(up_any, values, inf_))
+        smin = jnp.max(jnp.where(dn_any, values, -inf_))
+        return (lmin_l, lmax_l, lmin_r, lmax_r, smin, smax)
 
     cegb = cfg.cegb
     cegb_lazy = cfg.cegb_lazy and cegb
@@ -919,10 +1019,15 @@ def _grow_compact_impl(cfg: GrowConfig,
             nanb = feat_nan_bin[f]
             left_direct = jnp.where((nanb >= 0) & (col == nanb), dl,
                                     col <= t)
-            # member bins > t occupy positions [off + t, off + nb - 2]
-            right_multi = (col >= off + t) & (col <= off + nb - 2)
+            # member bins > t occupy positions [off + t, off + nb - 2];
+            # a NaN member's NaN bin maps to its LAST position, which
+            # routes by the learned default direction instead
+            is_nanrow = (nanb >= 0) & (col == off + nanb - 1)
+            right_multi = (col >= off + t) & (col <= off + nb - 2) \
+                & ~is_nanrow
+            left_multi = jnp.where(is_nanrow, dl, ~right_multi)
             return jnp.where(bundle_is_direct[f], left_direct,
-                             ~right_multi)
+                             left_multi)
         fsel = jnp.arange(F) == f
         col = jnp.max(jnp.where(fsel[None, :], blk_b, 0),
                       axis=1).astype(jnp.int32)
@@ -1317,6 +1422,16 @@ def _grow_compact_impl(cfg: GrowConfig,
         if intermediate:
             mono_state = mono_state + (jnp.zeros((L, L - 1), jnp.int8),)
         root_bounds = (leaf_min0[0], leaf_max0[0])
+        if advanced:
+            # per-leaf bin-space boxes [lo, hi) per feature; the root
+            # covers everything
+            box_lo0 = jnp.zeros((L, F), jnp.int32)
+            box_hi0 = jnp.full((L, F), B, jnp.int32)
+            mono_state = mono_state + (box_lo0, box_hi0)
+            root_bounds = advanced_bounds(box_lo0, box_hi0,
+                                          tree.leaf_value,
+                                          tree.num_leaves,
+                                          box_lo0[0], box_hi0[0])
     nmask_state = ()
     root_node_mask = None
     if use_bynode:
@@ -1338,11 +1453,6 @@ def _grow_compact_impl(cfg: GrowConfig,
     # feature_histogram.hpp; budget from histogram_pool_size)
     pooled = 0 < cfg.hist_pool_slots < L
     PS = cfg.hist_pool_slots if pooled else L
-    if pooled and (cegb or intermediate or forced is not None):
-        raise NotImplementedError(
-            "hist_pool_slots is incompatible with CEGB / intermediate "
-            "monotone / forced splits (their re-search walks every "
-            "leaf's cached histogram); gbdt.py gates these")
     hists = jnp.zeros((PS, FH, B, 2),
                       jnp.int32 if quant else dtype).at[0].set(root_hist)
     pool_state = ()
@@ -1374,14 +1484,84 @@ def _grow_compact_impl(cfg: GrowConfig,
             return jnp.asarray(True)
         return d < cfg.max_depth
 
-    def research_all(tree, hists, branch, cegb_st, mono_st, nmask_st
-                     ) -> _BestSplits:
+    def _leaf_mask_pen_bounds(tree, branch, cegb_st, mono_st, nmask_st,
+                              l):
+        """One leaf's (mask, penalty, bounds) under the CURRENT state —
+        the per-leaf body shared by the pooled re-search."""
+        mask_l = None
+        if interaction_groups is not None:
+            mask_l = allowed_features(branch[l])
+        if use_bynode:
+            nm = nmask_st[0][l]
+            mask_l = nm if mask_l is None else mask_l & nm
+        pen_l = None
+        if cegb:
+            coupled_used, _, lazy_nu = cegb_st
+            pen_l = cegb_penalty(tree.leaf_count[l], coupled_used,
+                                 lazy_nu[l])
+        bounds_l = None
+        if has_mono:
+            if advanced:
+                bounds_l = advanced_bounds(mono_st[3], mono_st[4],
+                                           tree.leaf_value,
+                                           tree.num_leaves,
+                                           mono_st[3][l], mono_st[4][l])
+            else:
+                bounds_l = (mono_st[0][l], mono_st[1][l])
+        return mask_l, pen_l, bounds_l
+
+    def _research_leafwise(tree, hists, branch, cegb_st, mono_st,
+                           nmask_st, pool_ctx) -> _BestSplits:
+        """Leaf-walking re-search (lax.fori_loop over leaf slots).
+
+        Used (a) under the histogram pool: each leaf's histogram comes
+        from its slot or a window recompute — the reference pool's
+        recompute-on-miss (HistogramPool::Get, feature_histogram.hpp)
+        feeding the stored-candidate patching consumers; and (b) under
+        advanced monotone even unpooled: the per-leaf bound tensors are
+        [L, F, B] each, so vmapping them over leaves would materialize
+        O(L^2*F*B) intermediates (~GBs at 255 leaves x 28 x 256) where
+        this walk peaks at O(L*F*B) like the reference's per-leaf
+        traversal."""
+
+        def body(l, best):
+            if pool_ctx is not None:
+                bins2, pay2, leaf_buf, lbegin, lcount, leaf2slot = \
+                    pool_ctx
+                slot = leaf2slot[l]
+                hist = lax.cond(
+                    slot >= 0,
+                    lambda: lax.dynamic_index_in_dim(
+                        hists, jnp.maximum(slot, 0), keepdims=False),
+                    lambda: window_hist(bins2, pay2, leaf_buf[l],
+                                        lbegin[l], lcount[l]))
+            else:
+                hist = lax.dynamic_index_in_dim(hists, l,
+                                                keepdims=False)
+            hf = hist_f(hist)
+            sums = hf[0].sum(axis=0)
+            mask_l, pen_l, bounds_l = _leaf_mask_pen_bounds(
+                tree, branch, cegb_st, mono_st, nmask_st, l)
+            r = best_for(hf, sums[0], sums[1], tree.leaf_count[l],
+                         mask_l, pen_l, tree.leaf_value[l],
+                         tree.leaf_depth[l], bounds_l)
+            active = (l < tree.num_leaves) \
+                & depth_ok(tree.leaf_depth[l])
+            return best.store(l, r, active)
+
+        return lax.fori_loop(0, L, body, _BestSplits.init(L, B, dtype))
+
+    def research_all(tree, hists, branch, cegb_st, mono_st, nmask_st,
+                     pool_ctx=None) -> _BestSplits:
         """Re-search every leaf's best split from the cached histograms
         under the CURRENT penalties / interaction masks / monotone
         bounds. Exact replacement for the reference's stored-candidate
         patching (CEGB UpdateLeafBestSplits,
         cost_effective_gradient_boosting.hpp:100-124; intermediate
         monotone leaves_to_update, monotone_constraints.hpp:560+)."""
+        if pooled or advanced:
+            return _research_leafwise(tree, hists, branch, cegb_st,
+                                      mono_st, nmask_st, pool_ctx)
         hf = jax.vmap(hist_f)(hists)              # [L, F, B, 2]
         sums = hf[:, 0].sum(axis=1)               # [L, 2]
         in_axes = [0, 0, 0, 0]
@@ -1405,6 +1585,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         in_axes.extend([0, 0])
         args.extend([tree.leaf_value, tree.leaf_depth])
         if has_mono:
+            # (advanced never reaches here — it re-searches leaf-wise)
             in_axes.append((0, 0))
             args.append((mono_st[0], mono_st[1]))
         else:
@@ -1505,6 +1686,11 @@ def _grow_compact_impl(cfg: GrowConfig,
         else:
             hists = hists.at[leaf].set(left_hist).at[R].set(right_hist)
 
+        # context for the pooled re-search paths (hist per leaf from
+        # slot or window recompute)
+        pool_ctx = (bins2, pay2, leaf_buf, lbegin, lcount,
+                    pool_st[0]) if pooled else None
+
         # -- monotone output-bound entries (BasicLeafConstraints::Update /
         # IntermediateLeafConstraints::UpdateConstraintsWithOutputs) --
         wl_out = best.left_output[leaf]
@@ -1532,9 +1718,33 @@ def _grow_compact_impl(cfg: GrowConfig,
                 anc = mono_st[2]
                 anc = anc.at[R].set(anc[leaf])
                 anc = anc.at[leaf, ns].set(1).at[R, ns].set(2)
-                mono_st = (lmin, lmax, anc)
+                mono_st = (lmin, lmax, anc) + mono_st[3:]
             bounds_l = (new_min_l, new_max_l)
             bounds_r = (new_min_r, new_max_r)
+            if advanced:
+                # split the parent's bin-space box between the children
+                # (categorical splits leave both boxes = parent's) and
+                # compute each child's per-threshold bounds from the
+                # post-split leaf set
+                blo, bhi = mono_st[3], mono_st[4]
+                fsel = jnp.arange(F) == f_split
+                cut_num = fsel & is_num
+                l_hi = jnp.where(cut_num,
+                                 jnp.minimum(bhi[leaf], t_bin + 1),
+                                 bhi[leaf])
+                r_lo = jnp.where(cut_num,
+                                 jnp.maximum(blo[leaf], t_bin + 1),
+                                 blo[leaf])
+                blo = blo.at[R].set(r_lo)
+                bhi = bhi.at[R].set(bhi[leaf])
+                bhi = bhi.at[leaf].set(l_hi)
+                mono_st = mono_st[:3] + (blo, bhi)
+                bounds_l = advanced_bounds(blo, bhi, tree.leaf_value,
+                                           tree.num_leaves,
+                                           blo[leaf], bhi[leaf])
+                bounds_r = advanced_bounds(blo, bhi, tree.leaf_value,
+                                           tree.num_leaves,
+                                           blo[R], bhi[R])
 
         # -- child best splits --
         can_go_deeper = depth_ok(new_depth)
@@ -1576,16 +1786,16 @@ def _grow_compact_impl(cfg: GrowConfig,
 
         mask2 = None if mask_l is None else stack2(mask_l, mask_r)
         pen2 = None if pen_l is None else stack2(pen_l, pen_r)
-        bounds2 = None if bounds_l is None else (
-            stack2(bounds_l[0], bounds_r[0]),
-            stack2(bounds_l[1], bounds_r[1]))
+        bounds2 = None if bounds_l is None else tuple(
+            stack2(a, b) for a, b in zip(bounds_l, bounds_r))
         r2 = jax.vmap(
             best_for,
             in_axes=(0, 0, 0, 0,
                      None if mask2 is None else 0,
                      None if pen2 is None else 0,
                      0, None,
-                     None if bounds2 is None else (0, 0)))(
+                     None if bounds2 is None
+                     else tuple(0 for _ in bounds2)))(
             stack2(hist_f(left_hist), hist_f(right_hist)),
             stack2(best.left_sum_g[leaf], best.right_sum_g[leaf]),
             stack2(best.left_sum_h[leaf], best.right_sum_h[leaf]),
@@ -1602,7 +1812,7 @@ def _grow_compact_impl(cfg: GrowConfig,
             # (GoUpToFindLeavesToUpdate): a leaf under a monotone
             # ancestor is bounded by the extreme CURRENT outputs of the
             # sibling subtree — then re-search all stored candidates.
-            lmin, lmax, anc = mono_st
+            lmin, lmax, anc = mono_st[:3]
             v = tree.leaf_value
             active = jnp.arange(L) < tree.num_leaves
             node_mc = monotone_constraints[tree.split_feature] \
@@ -1630,9 +1840,9 @@ def _grow_compact_impl(cfg: GrowConfig,
                         axis=1),
                 jnp.max(jnp.where(in_l & ~inc_n, rmax_sub[None, :], -inf_),
                         axis=1))
-            mono_st = (lb, ub, anc)
+            mono_st = (lb, ub, anc) + mono_st[3:]
             best = research_all(tree, hists, branch, cegb_st, mono_st,
-                                nmask_st)
+                                nmask_st, pool_ctx)
 
         if cegb_coupled and not intermediate:
             # (when intermediate monotone is on, the unconditional
@@ -1648,7 +1858,7 @@ def _grow_compact_impl(cfg: GrowConfig,
             best = lax.cond(
                 first_use,
                 lambda b: research_all(tree, hists, branch, cegb_st,
-                                       mono_st, nmask_st),
+                                       mono_st, nmask_st, pool_ctx),
                 lambda b: b, best)
 
         return _CompactState(tree=tree, best=best, hists=hists,
@@ -1717,7 +1927,19 @@ def _grow_compact_impl(cfg: GrowConfig,
         serial_tree_learner.cpp:695-699), not just itself."""
         bnds = None if not has_mono \
             else (state.mono[0][leaf], state.mono[1][leaf])
-        r = forced_result(hist_f(state.hists[leaf]),
+        if pooled:
+            slot = state.pool[0][leaf]
+            hist_l = lax.cond(
+                slot >= 0,
+                lambda: lax.dynamic_index_in_dim(
+                    state.hists, jnp.maximum(slot, 0), keepdims=False),
+                lambda: window_hist(state.bins2, state.pay2,
+                                    state.leaf_buf[leaf],
+                                    state.leaf_begin[leaf],
+                                    state.leaf_count[leaf]))
+        else:
+            hist_l = state.hists[leaf]
+        r = forced_result(hist_f(hist_l),
                           state.tree.leaf_count[leaf], f, t,
                           state.tree.leaf_value[leaf], bnds)
         valid = ok & (r.left_count > 0) & (r.right_count > 0)

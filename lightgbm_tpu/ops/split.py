@@ -106,6 +106,23 @@ def smooth_output(w, cnt, parent_output, p: SplitParams):
     return w * a / (a + 1.0) + parent_output / (a + 1.0)
 
 
+def split_bounds_lrc(bounds):
+    """Resolve a bounds spec into (left, right, cat) bound pairs.
+
+    2-tuple (min, max): one bound for both children (basic/intermediate
+    modes — scalars). 6-tuple (lmin_l, lmax_l, lmin_r, lmax_r, smin,
+    smax): per-(feature, threshold) [F, B] arrays for the left/right
+    children plus scalar fallbacks for categorical candidates — the
+    monotone precise mode (AdvancedLeafConstraints,
+    monotone_constraints.hpp:858)."""
+    if bounds is None:
+        return None, None, None
+    if len(bounds) == 6:
+        return ((bounds[0], bounds[1]), (bounds[2], bounds[3]),
+                (bounds[4], bounds[5]))
+    return bounds, bounds, bounds
+
+
 def constrained_output(sum_g, sum_h, cnt, parent_output, bounds,
                        p: SplitParams):
     """Optimal output, then smoothing, then monotone min/max clamp — the
@@ -296,6 +313,7 @@ def find_best_split(hist: jnp.ndarray,
     exact = p.path_smooth > 0.0 or bounds is not None
     p_out = jnp.asarray(0.0, dtype) if parent_output is None \
         else parent_output
+    bounds_l, bounds_r, bounds_c = split_bounds_lrc(bounds)
 
     def eval_dir(left: jnp.ndarray, t_valid: jnp.ndarray):
         right = total[None, None, :] - left
@@ -309,8 +327,8 @@ def find_best_split(hist: jnp.ndarray,
             & (lc > 0) & (rc > 0)
         )
         if exact:
-            lo = constrained_output(lg, lh, lc, p_out, bounds, p)
-            ro = constrained_output(rg, rh, rc, p_out, bounds, p)
+            lo = constrained_output(lg, lh, lc, p_out, bounds_l, p)
+            ro = constrained_output(rg, rh, rc, p_out, bounds_r, p)
             gain = gain_at_output(lg, lh, lo, p) \
                 + gain_at_output(rg, rh, ro, p)
         else:
@@ -345,7 +363,7 @@ def find_best_split(hist: jnp.ndarray,
         gains_l = jnp.where(num_ok, gains_l, K_MIN_SCORE)
         g_oh, g_fwd, g_bwd, csum_f, csum_b, (inv, used, participate) = \
             _cat_split_eval(hist, total[0], total[1], total[2],
-                            feat_num_bins, p, p_out, bounds)
+                            feat_num_bins, p, p_out, bounds_c)
         cmask = fmask & feat_is_cat[:, None]
         g_oh = jnp.where(cmask, g_oh, K_MIN_SCORE)
         g_fwd = jnp.where(cmask, g_fwd, K_MIN_SCORE)
@@ -422,14 +440,23 @@ def find_best_split(hist: jnp.ndarray,
     # (feature_histogram.cpp:144 `l2 += cat_l2` before the output calc)
     p_cat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
     if exact:
+        # the winner's bounds: scalar pair as-is, or — for the advanced
+        # per-(feature, threshold) arrays — the values at (f, t) for
+        # the numeric winner / the scalar fallbacks for a cat winner
+        b_lw = b_rw = bounds
+        if bounds is not None and len(bounds) == 6:
+            b_lw = (jnp.where(is_cat, bounds[4], bounds[0][f, t]),
+                    jnp.where(is_cat, bounds[5], bounds[1][f, t]))
+            b_rw = (jnp.where(is_cat, bounds[4], bounds[2][f, t]),
+                    jnp.where(is_cat, bounds[5], bounds[3][f, t]))
         lo = jnp.where(
             is_sorted_cat,
-            constrained_output(lg, lh, lc, p_out, bounds, p_cat),
-            constrained_output(lg, lh, lc, p_out, bounds, p))
+            constrained_output(lg, lh, lc, p_out, b_lw, p_cat),
+            constrained_output(lg, lh, lc, p_out, b_lw, p))
         ro = jnp.where(
             is_sorted_cat,
-            constrained_output(rg, rh, rc, p_out, bounds, p_cat),
-            constrained_output(rg, rh, rc, p_out, bounds, p))
+            constrained_output(rg, rh, rc, p_out, b_rw, p_cat),
+            constrained_output(rg, rh, rc, p_out, b_rw, p))
     else:
         lo = jnp.where(is_sorted_cat, leaf_output(lg, lh, p_cat),
                        leaf_output(lg, lh, p))
@@ -463,20 +490,24 @@ def find_best_split_bundled(hist: jnp.ndarray,
                             tloc_at: jnp.ndarray,
                             end_at: jnp.ndarray,
                             is_direct_f: jnp.ndarray,
-                            feat_nan_bin: jnp.ndarray,
+                            nanpos_at: jnp.ndarray,
+                            nan_at: jnp.ndarray,
                             feature_mask: jnp.ndarray,
                             p: SplitParams) -> SplitResult:
     """Best split over an EFB-bundled histogram (ops/bundling.py layout).
 
     Every candidate is one (bundle, position) cell:
     - direct (singleton) bundles behave exactly like the plain scan:
-      ``left = cum[position]`` with threshold = position, INCLUDING the
-      dual missing-direction scan for features carrying a NaN bin
-      (multi-member bundles never do - eligibility excludes them);
+      ``left = cum[position]`` with threshold = position;
     - multi-member bundles host member thresholds at their mapped
       positions, with ``left = leaf_total - (range_end_cum - cum)`` -
       the member's bin-0 mass reconstructed from the leaf totals (the
       FixHistogram / most_freq_bin trick, dataset.h:760).
+    Members with a NaN bin (direct OR multi) get the plain search's
+    dual missing-direction scan: the NaN position (``nan_at``) is
+    excluded from prefix sums and thresholds, and its mass
+    (``nanpos_at``) joins whichever side the scanned direction sends
+    missing rows to.
     """
     G, B, _ = hist.shape
     dtype = hist.dtype
@@ -488,20 +519,19 @@ def find_best_split_bundled(hist: jnp.ndarray,
     has_member = member_at >= 0
     member_ix = jnp.maximum(member_at, 0)
     direct_pos = is_direct_f[member_ix] & has_member
-    # direct singletons may carry a NaN bin; exclude it from the prefix
-    # scan exactly like the plain search (missing rows join a side via
-    # the learned default direction, never the threshold)
-    nanb = jnp.where(direct_pos, feat_nan_bin[member_ix], -1)  # [G, B]
-    is_nan_pos = (tloc_at == nanb) & (nanb >= 0)
+    # NaN-bin positions are excluded from the prefix scan exactly like
+    # the plain search (missing rows join a side via the learned
+    # default direction, never the threshold)
+    has_nan = nanpos_at >= 0                               # [G, B]
     cum = jnp.cumsum(
-        h3 * (~is_nan_pos)[:, :, None].astype(dtype), axis=1)
+        h3 * (~nan_at)[:, :, None].astype(dtype), axis=1)
     cum_flat = cum.reshape(G * B, 3)
     e = cum_flat[jnp.clip(end_at, 0, G * B - 1).reshape(-1)] \
         .reshape(G, B, 3)
-    nan_idx = jnp.clip(nanb, 0, B - 1)
-    nan_stats = jnp.take_along_axis(
-        h3, jnp.broadcast_to(nan_idx[:, :, None], (G, B, 3)), axis=1)
-    nan_stats = nan_stats * (nanb >= 0)[:, :, None].astype(dtype)
+    h3_flat = h3.reshape(G * B, 3)
+    nan_stats = h3_flat[jnp.clip(nanpos_at, 0, G * B - 1).reshape(-1)] \
+        .reshape(G, B, 3)
+    nan_stats = nan_stats * has_nan[:, :, None].astype(dtype)
 
     def eval_left(left, extra_valid):
         right = total[None, None, :] - left
@@ -517,13 +547,19 @@ def find_best_split_bundled(hist: jnp.ndarray,
         gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p)
         return jnp.where(valid, gain, K_MIN_SCORE)
 
-    # direction 1: missing goes right
+    # direction 1: missing goes right. For multi members the member's
+    # right side is its positions in (t, range_end] (NaN excluded by
+    # cum) plus its NaN mass; left = total - right. Like the plain
+    # scan, every member threshold is a candidate — the cut at the NaN
+    # position duplicates its neighbor and is tolerated (degenerate
+    # cuts are pruned by the lc/rc validity checks).
     left1 = jnp.where(direct_pos[:, :, None], cum,
-                      total[None, None, :] - (e - cum))
+                      total[None, None, :] - (e - cum) - nan_stats)
     g1 = eval_left(left1, jnp.ones((G, B), bool))
-    # direction 2: missing joins the left side (direct NaN features)
-    left2 = cum + nan_stats
-    g2 = eval_left(left2, nanb >= 0)
+    # direction 2: missing joins the left side (NaN members only)
+    left2 = jnp.where(direct_pos[:, :, None], cum + nan_stats,
+                      total[None, None, :] - (e - cum))
+    g2 = eval_left(left2, has_nan)
 
     parent_gain = leaf_gain(total[0], total[1], p)
     shift = parent_gain + p.min_gain_to_split

@@ -71,13 +71,57 @@ def test_pool_disabled_when_budget_suffices():
     assert bst._engine.grow_cfg.hist_pool_slots == 0
 
 
-def test_pool_gated_off_for_cegb():
-    X, y = _data(n=800, f=5)
-    bst = lgb.train({"objective": "binary", "num_leaves": 15,
-                     "histogram_pool_size": 0.001,
-                     "cegb_penalty_split": 1e-6, "verbosity": -1},
-                    lgb.Dataset(X, label=y), num_boost_round=2)
-    assert bst._engine.grow_cfg.hist_pool_slots == 0
+def _exact_quant_pair(extra, n=2500, f=12, rounds=4, seed=0,
+                      leaves=31):
+    """Train full-cache vs pooled under quantized gradients (exact
+    int32 histograms -> bit-identical trees) with ``extra`` params."""
+    X, y = _data(n=n, f=f, seed=seed)
+    base = {"objective": "binary", "num_leaves": leaves, "verbosity": -1,
+            "min_data_in_leaf": 10, "seed": 3,
+            "use_quantized_grad": True, "stochastic_rounding": False}
+    base.update(extra)
+    full = lgb.train(base, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds)
+    per_leaf_mb = f * 256 * 2 * 4 / 2 ** 20
+    pooled = lgb.train({**base,
+                        "histogram_pool_size": 6.4 * per_leaf_mb},
+                       lgb.Dataset(X, label=y), num_boost_round=rounds)
+    assert 0 < pooled._engine.grow_cfg.hist_pool_slots < leaves, \
+        "pool did not engage"
+    tf, tp = _trees(full), _trees(pooled)
+    for a, b in zip(tf, tp):
+        assert a["num_leaves"] == b["num_leaves"]
+        assert a["tree_structure"] == b["tree_structure"]
+    np.testing.assert_allclose(full.predict(X[:200]),
+                               pooled.predict(X[:200]), rtol=1e-6)
+    return full, pooled
+
+
+def test_pool_with_cegb_tree_exact():
+    """Round 4: CEGB's stored-candidate re-search now runs under the
+    pool (recompute-on-miss), tree-exact vs the full cache — the
+    reference pool serves CEGB too (feature_histogram.hpp)."""
+    _exact_quant_pair({"cegb_penalty_split": 1e-4,
+                       "cegb_tradeoff": 0.5,
+                       "cegb_penalty_feature_coupled":
+                           [0.01] * 12})
+
+
+def test_pool_with_intermediate_monotone_tree_exact():
+    """Intermediate monotone's every-split re-search under the pool."""
+    _exact_quant_pair({"monotone_constraints":
+                           [1, -1] + [0] * 10,
+                       "monotone_constraints_method": "intermediate"})
+
+
+def test_pool_with_forced_splits_tree_exact(tmp_path):
+    """Forced splits read the parent histogram through the pool."""
+    import json
+    p = tmp_path / "forced.json"
+    p.write_text(json.dumps({"feature": 0, "threshold": 0.0,
+                             "left": {"feature": 1,
+                                      "threshold": 0.0}}))
+    _exact_quant_pair({"forcedsplits_filename": str(p)})
 
 
 def test_wide_dense_matrix_trains_with_bounded_cache():
