@@ -228,10 +228,33 @@ def main():
     print(json.dumps(result))
 
 
-if __name__ == "__main__":
+def _supervise():
+    """Run the real bench in a child process under a hard timeout.
+
+    The parent holds no jax state, so it can ALWAYS emit the one-line
+    JSON record even when the child hangs in native backend-init code
+    (the half-dead-tunnel mode where no in-process mechanism fires)."""
+    hard = int(os.environ.get("BENCH_HARD_TIMEOUT", 5400))
+    env = dict(os.environ, BENCH_WORKER="1")
     try:
-        main()
-    except Exception as err:  # emit data, never a bare stack trace
-        import traceback
-        traceback.print_exc(file=sys.stderr)
-        _emit_failure(err)
+        r = subprocess.run([sys.executable] + sys.argv,
+                           env=env, timeout=hard)
+        if r.returncode != 0:
+            _emit_failure(RuntimeError(
+                f"bench worker exited rc={r.returncode}"))
+    except subprocess.TimeoutExpired:
+        _emit_failure(TimeoutError(
+            f"bench worker exceeded BENCH_HARD_TIMEOUT={hard}s "
+            "(hung backend init or run)"))
+
+
+if __name__ == "__main__":
+    if os.environ.get("BENCH_WORKER") != "1":
+        _supervise()
+    else:
+        try:
+            main()
+        except Exception as err:  # emit data, never a bare stack trace
+            import traceback
+            traceback.print_exc(file=sys.stderr)
+            _emit_failure(err)

@@ -738,7 +738,11 @@ class Dataset:
                 "linear_tree (the reference's two-pass loader has the "
                 "same restriction on raw-data consumers)")
         self._n, self._F_total = n, F
-        self._feature_names = [f"Column_{i}" for i in range(F)]
+        fn = self.feature_name
+        if isinstance(fn, list) and len(fn) == F:
+            self._feature_names = list(fn)
+        else:
+            self._feature_names = [f"Column_{i}" for i in range(F)]
         self._cat_idx = set(cat_set)
         self.mappers = mappers
         self._used_features = used
@@ -746,6 +750,11 @@ class Dataset:
         self._bins = bins
         self._F = len(mappers)
         self._raw_numeric = None
+        return self._install_metadata(y, weight, group, n)
+
+    def _install_metadata(self, y, weight, group, n) -> "Dataset":
+        """Shared construct() tail: metadata coercion + validation +
+        handle flip (used by the eager and two-round paths)."""
         self.label = y
         self.weight = None if weight is None else \
             np.asarray(weight, np.float64).ravel()

@@ -253,6 +253,19 @@ class GBDTBooster:
                     jnp.asarray(binfo.nan_at))
                 self.grow_cfg = self.grow_cfg._replace(
                     bundled=True, num_bins=binfo.num_positions)
+        # per-row id/in-bag tracking through the partition is only
+        # needed by bagging/GOSS (weight-0 rows), CEGB, or the bundled
+        # merge; plain full-data training drops the ord2 sort column
+        bag_active = cfg.bagging_freq > 0 and (
+            cfg.bagging_fraction < 1.0
+            or cfg.pos_bagging_fraction < 1.0
+            or cfg.neg_bagging_fraction < 1.0)
+        goss_active = (cfg.data_sample_strategy == "goss"
+                       or cfg.boosting == "goss")
+        self.grow_cfg = self.grow_cfg._replace(track_rows=(
+            bag_active or goss_active or self.cegb_enabled
+            or self.bundle is not None))
+
         # only ONE training matrix ever reaches HBM: bundled when EFB
         # engaged, the plain [F, n] matrix otherwise
         self.bins_T = jnp.asarray(self.bundle.bins_bundled.T) \

@@ -97,11 +97,15 @@ def build_bundles(bins: np.ndarray, mappers,
                   sample_rows: int = 32768,
                   sparse_threshold: float = 0.8,
                   seed: int = 0) -> Optional[BundleInfo]:
-    """Greedy conflict-free bundling over the binned matrix.
+    """Greedy bundling over the binned matrix.
 
-    Only zero-conflict merges are accepted (max_conflict_rate = 0): the
-    bundled model is then EXACTLY the unbundled model, split for split.
-    Returns None when bundling would not reduce the column count.
+    Merges tolerate up to ``S * MAX_CONFLICT_FRACTION`` conflicting
+    sampled rows per bundle (the reference's
+    single_val_max_conflict_cnt, dataset.cpp:115) — the later member's
+    value wins on a conflict row, a bounded approximation. With zero
+    actual conflicts the bundled model is EXACTLY the unbundled model,
+    split for split. Returns None when bundling would not reduce the
+    column count.
 
     Args:
       bins: [n, F] host bin matrix.
@@ -241,11 +245,19 @@ def build_bundles(bins: np.ndarray, mappers,
                 member_at[gi, lo:hi + 1] = j
                 tloc_at[gi, lo:hi + 1] = np.arange(nb)
                 end_at[gi, lo:hi + 1] = gi * B + off + nb - 2
+                # ALWAYS overwrite nanpos over the member's candidate
+                # range: position off-1 is shared with the PREVIOUS
+                # member's last slot, and if that member carried a NaN
+                # bin its stale nanpos/nan metadata would otherwise
+                # make this member's t=0 candidate misattribute the
+                # neighbor's NaN mass (round-4 review finding)
                 if nanb[j] >= 0:
                     # the member's NaN bin maps to its LAST position
                     p_nan = off + int(nanb[j]) - 1
                     nanpos_at[gi, lo:hi + 1] = gi * B + p_nan
                     nan_at[gi, p_nan] = True
+                else:
+                    nanpos_at[gi, lo:hi + 1] = -1
     return BundleInfo(final_groups, bundle_of, offset_of, is_direct,
                       out, B, member_at, tloc_at, end_at,
                       nanpos_at, nan_at)

@@ -32,6 +32,7 @@ from jax import lax
 
 from .histogram import (build_histogram, hist_from_rows,
                         hist_from_rows_int, subtract_histogram)
+from .predict import predict_leaf_binned
 from .split import (SplitParams, SplitResult, constrained_output,
                     find_best_split, find_best_split_bundled,
                     gain_at_output, leaf_gain, leaf_output)
@@ -138,6 +139,13 @@ class GrowConfig(NamedTuple):
     #           but Mosaic/XLA lower the stage chain poorly on TPU
     #           today; kept as an option + correctness oracle.
     partition: str = "sort"
+    # carry per-row ids + in-bag bits (ord2) through the partition.
+    # Only needed when something consumes them: exact in-bag child
+    # counts under bagging/GOSS (weight-0 rows), CEGB's lazy per-row
+    # feature sets, or the bundled final merge. Plain full-data
+    # training (the benchmark path) drops the column: one less sort
+    # operand in every chunk body and no in-bag bookkeeping.
+    track_rows: bool = True
 
 
 class TreeArrays(NamedTuple):
@@ -919,6 +927,9 @@ def _grow_compact_impl(cfg: GrowConfig,
                 pen = pen + cfg.cegb_tradeoff * pen_lazy * lazy_nu_leaf
             return pen
 
+    # row-id / in-bag tracking (see GrowConfig.track_rows); consumers
+    # force it on regardless of the flag
+    track = cfg.track_rows or cegb or bundled
     bins_rm = bins_T.T                      # [n, F] row-major for gathers
     w = row_weight.astype(dtype)
     inbag = row_weight > 0
@@ -1196,15 +1207,21 @@ def _grow_compact_impl(cfg: GrowConfig,
                 blk_b = _unpack_bins(tuple(blk_w[:, i]
                                            for i in range(NW)))
                 blk_p = lax.dynamic_slice(pay2, (pos0, 0), (CK, C))
-                blk_o = lax.dynamic_slice(ord2, (pos0,), (CK,))
-                blk_i = (blk_o & _IB_BIT) != 0
                 gl = chunk_goleft(blk_b, f, t, dl, isc, cm)
                 valid = iota_c < jnp.clip(cnt - off, 0, CK)
                 vl = valid & gl
                 l_c = jnp.sum(vl.astype(jnp.int32))
                 r_c = jnp.sum((valid & ~gl).astype(jnp.int32))
-                nlib += jnp.sum((vl & blk_i).astype(jnp.int32))
-                nib += jnp.sum((valid & blk_i).astype(jnp.int32))
+                if track:
+                    blk_o = lax.dynamic_slice(ord2, (pos0,), (CK,))
+                    blk_i = (blk_o & _IB_BIT) != 0
+                    nlib += jnp.sum((vl & blk_i).astype(jnp.int32))
+                    nib += jnp.sum((valid & blk_i).astype(jnp.int32))
+                else:
+                    # every row is in-bag: the partition counts ARE the
+                    # in-bag counts
+                    nlib += l_c
+                    nib += l_c + r_c
                 if cegb_lazy:
                     rows = (blk_o & ~_IB_BIT).astype(jnp.int32)
                     # the split acquires feature f for every in-bag row
@@ -1217,7 +1234,7 @@ def _grow_compact_impl(cfg: GrowConfig,
                 # u32-tiled, avoiding the u8 (4,1) sub-byte layout tax
                 # on every slice/RMW write)
                 cols = tuple(blk_w[:, i] for i in range(NW)) \
-                    + _pack_pay(blk_p) + (blk_o,)
+                    + _pack_pay(blk_p) + ((blk_o,) if track else ())
                 ml = iota_c < l_c
                 o_r = dst_base + cnt - r_off - CK
                 mr = iota_c >= (CK - r_c)
@@ -1229,10 +1246,11 @@ def _grow_compact_impl(cfg: GrowConfig,
                     rops = route_concentrate(cols, valid & ~gl, CK - r_c)
                     lb = jnp.stack(lops[:NW], axis=1)
                     lp = _unpack_pay(lops[NW:NW + NPAY])
-                    lo = lops[NW + NPAY]
                     rb = jnp.stack(rops[:NW], axis=1)
                     rp = _unpack_pay(rops[NW:NW + NPAY])
-                    ro = rops[NW + NPAY]
+                    if track:
+                        lo = lops[NW + NPAY]
+                        ro = rops[NW + NPAY]
                 else:
                     # stable in-chunk partition: one variadic sort
                     # moving all row data by a (side, position) key
@@ -1241,18 +1259,21 @@ def _grow_compact_impl(cfg: GrowConfig,
                     ops = lax.sort((key,) + cols, num_keys=1)
                     lb = jnp.stack(ops[1:1 + NW], axis=1)
                     lp = _unpack_pay(ops[1 + NW:1 + NW + NPAY])
-                    lo = ops[1 + NW + NPAY]
                     # rights [l_c, l_c+r_c) rotated to the block END
                     s_r = lax.rem(l_c + r_c, jnp.asarray(CK, jnp.int32))
-                    rb, rp, ro = rot(lb, s_r), rot(lp, s_r), rot(lo, s_r)
+                    rb, rp = rot(lb, s_r), rot(lp, s_r)
+                    if track:
+                        lo = ops[1 + NW + NPAY]
+                        ro = rot(lo, s_r)
                 # lefts [0, l_c) forward in place; rights packed
                 # backward from the window end in the other half
                 bins2 = write(bins2, src_base + l_off, lb, ml)
                 pay2 = write(pay2, src_base + l_off, lp, ml)
-                ord2 = write(ord2, src_base + l_off, lo, ml)
                 bins2 = write(bins2, o_r, rb, mr)
                 pay2 = write(pay2, o_r, rp, mr)
-                ord2 = write(ord2, o_r, ro, mr)
+                if track:
+                    ord2 = write(ord2, src_base + l_off, lo, ml)
+                    ord2 = write(ord2, o_r, ro, mr)
                 return (bins2, pay2, ord2, lazy_used,
                         l_off + l_c, r_off + r_c, nlib, nib)
 
@@ -1464,13 +1485,14 @@ def _grow_compact_impl(cfg: GrowConfig,
         )
     pay0 = gw2_q if quant \
         else (gw2.astype(jnp.bfloat16) if bf16_pay else gw2)
-    ord0 = jnp.arange(n, dtype=jnp.uint32) \
-        | jnp.where(inbag, _IB_BIT, jnp.uint32(0))
+    ord0 = (jnp.arange(n, dtype=jnp.uint32)
+            | jnp.where(inbag, _IB_BIT, jnp.uint32(0))) if track \
+        else jnp.zeros((2,), jnp.uint32)
     state = _CompactState(
         tree=tree, best=best, hists=hists,
         bins2=jnp.pad(bins_pk, ((PAD, PAD + SEG), (0, 0))),
         pay2=jnp.pad(pay0, ((PAD, PAD + SEG), (0, 0))),
-        ord2=jnp.pad(ord0, (PAD, PAD + SEG)),
+        ord2=jnp.pad(ord0, (PAD, PAD + SEG)) if track else ord0,
         leaf_buf=jnp.zeros((L,), jnp.int32),
         leaf_begin=jnp.zeros((L,), jnp.int32),
         leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(n),
@@ -1968,16 +1990,35 @@ def _grow_compact_impl(cfg: GrowConfig,
             & (jnp.max(state.best.gain) > 0.0)
 
     state = lax.while_loop(can_grow, do_split, state)
-    # merge the per-leaf windows (each living in one ping-pong half)
-    # into one coherent order vector, then invert
-    leaf_of_pos = _leaf_of_positions(state.leaf_begin, state.leaf_count,
-                                     n, L)
-    in_b1 = _leaf_values_at_positions(state.leaf_begin, state.leaf_count,
-                                      state.leaf_buf, n) == 1
-    order_m = jnp.where(in_b1, state.ord2[SEG + PAD: SEG + PAD + n],
-                        state.ord2[PAD: PAD + n])
-    order_ids = (order_m & ~_IB_BIT).astype(jnp.int32)
-    row_leaf = _row_leaf_from_order(order_ids, leaf_of_pos)
+    if bundled:
+        # bundle columns can't be re-routed by the predictor (the tree
+        # references ORIGINAL features); merge the per-leaf windows
+        # (each living in one ping-pong half) into one coherent order
+        # vector, then invert
+        leaf_of_pos = _leaf_of_positions(state.leaf_begin,
+                                         state.leaf_count, n, L)
+        in_b1 = _leaf_values_at_positions(
+            state.leaf_begin, state.leaf_count, state.leaf_buf, n) == 1
+        order_m = jnp.where(in_b1, state.ord2[SEG + PAD: SEG + PAD + n],
+                            state.ord2[PAD: PAD + n])
+        order_ids = (order_m & ~_IB_BIT).astype(jnp.int32)
+        row_leaf = _row_leaf_from_order(order_ids, leaf_of_pos)
+    else:
+        # re-route rows through the finished tree with the in-order
+        # node sweep (ops/predict.py) instead of inverting ord2 with
+        # two FULL-LENGTH variadic sorts: the sweep is nn sequential
+        # [n] column selects, while an n-row bitonic sort moves
+        # ~log^2(n) passes of row data through HBM — at 10.5M rows the
+        # sorts dwarf the sweep. Routing semantics are identical to
+        # chunk_goleft (same thresholds, NaN bins, cat masks).
+        t = state.tree
+        row_leaf = predict_leaf_binned(
+            t.split_feature, t.threshold_bin, t.default_left,
+            t.left_child, t.right_child, feat_nan_bin, bins_T,
+            t.split_is_cat if has_cat else None,
+            t.split_cat_mask if has_cat else None)
+        # an ungrown tree has no internal node 0 to route through
+        row_leaf = jnp.where(t.num_leaves > 1, row_leaf, 0)
     tree = state.tree
     if quant and cfg.renew_leaf:
         # RenewIntGradTreeOutput (gradient_discretizer.hpp): replace the

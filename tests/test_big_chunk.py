@@ -64,3 +64,38 @@ def test_big_chunk_with_bagging_and_cat():
     for t0, t1 in zip(b0._models, b1._models):
         np.testing.assert_array_equal(t0.split_feature, t1.split_feature)
     np.testing.assert_array_equal(b0.predict(X), b1.predict(X))
+
+
+def test_untracked_rows_bit_identical_to_tracked():
+    """GrowConfig.track_rows=False (plain full-data path, round 4)
+    drops the ord2 sort column; under quantized gradients the grown
+    tree AND row_leaf must be bit-identical to the tracked path."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.grow import GrowConfig, grow_tree
+    from lightgbm_tpu.ops.split import SplitParams
+
+    rs = np.random.RandomState(2)
+    n, f, B = 5000, 6, 64
+    bins_T = jnp.asarray(rs.randint(0, B - 1, size=(f, n)), jnp.uint8)
+    y = (np.asarray(bins_T)[0] > 30).astype(np.float32)
+    grad = jnp.asarray(0.5 - y + 0.1 * rs.randn(n).astype(np.float32))
+    hess = jnp.full((n,), 0.25, jnp.float32)
+    ones = jnp.ones((n,), jnp.float32)
+    fmask = jnp.ones((f,), bool)
+    fnb = jnp.full((f,), B - 1, jnp.int32)
+    fnan = jnp.full((f,), -1, jnp.int32)
+    outs = {}
+    for track in (True, False):
+        cfg = GrowConfig(num_leaves=31, num_bins=B,
+                         split=SplitParams(min_data_in_leaf=5),
+                         hist_method="scatter", quantized=True,
+                         stochastic=False, track_rows=track)
+        tree, row_leaf = grow_tree(cfg, bins_T, grad, hess, ones,
+                                   fmask, fnb, fnan)
+        outs[track] = (tree, row_leaf)
+    t1, rl1 = outs[True]
+    t0, rl0 = outs[False]
+    np.testing.assert_array_equal(np.asarray(rl1), np.asarray(rl0))
+    for a, b in zip(t1, t0):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

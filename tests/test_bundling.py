@@ -223,3 +223,49 @@ def test_allstate_shaped_wide_sparse_with_nan_trains_bundled():
     pred = bundled.predict(X)
     acc = np.mean((pred > 0.5) == (y > 0.5))
     assert acc > 0.85, acc
+
+
+def test_nan_member_boundary_slot_not_stale():
+    """A NaN member followed by a NaN-free member shares the boundary
+    position (prev's NaN slot == next's t=0 candidate); the next
+    member's candidate metadata must NOT inherit the stale NaN pointer
+    (round-4 review regression)."""
+    rs = np.random.RandomState(21)
+    n = 4000
+    # two exclusive sparse features: A rich (many bins, NaN), B binary
+    pick = rs.randint(0, 6, n)  # 0: A nonzero, 1: B nonzero, else 0
+    A = np.where(pick == 0, rs.randint(1, 40, n) / 4.0, 0.0)
+    A[(pick == 0) & (rs.rand(n) < 0.4)] = np.nan
+    Bcol = np.where(pick == 1, 1.0, 0.0)
+    X = np.column_stack([A, Bcol, rs.randn(n), rs.randn(n)])
+    y = ((np.nan_to_num(A) + Bcol + 0.3 * X[:, 2]) >
+         0.8).astype(float)
+    d = lgb.Dataset(X, label=y)
+    d.construct()
+    info = build_bundles(d.host_bins(), d.mappers)
+    assert info is not None
+    ga, gb = info.bundle_of[0], info.bundle_of[1]
+    assert ga == gb and not info.is_direct[0], "A+B did not bundle"
+    # whichever member comes second: its t=0 slot (off-1) must carry
+    # ITS OWN nan pointer (-1 for the NaN-free member), never the
+    # neighbor's
+    for j in (0, 1):
+        off = int(info.offset_of[j])
+        own_nan = d.mappers[j].missing_type == "nan"
+        got = int(info.nanpos_at[ga, off - 1])
+        if own_nan:
+            nb = d.mappers[j].num_bins
+            assert got == ga * info.num_positions + off + nb - 2, got
+        else:
+            assert got == -1, got
+    # and end-to-end: bundled tracks unbundled (deep noise-feature
+    # near-ties may flip under the FixHistogram float algebra, so the
+    # check is decision-level)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=4)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=4)
+    pp, pb = plain.predict(X), bundled.predict(X)
+    assert np.mean((pp > 0.5) == (pb > 0.5)) > 0.995

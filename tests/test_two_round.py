@@ -110,15 +110,21 @@ def test_two_round_peak_memory_below_eager(tmp_path):
     back-to-back in one subprocess: two-round first, then eager — the
     eager path holds [n, F+1] float64 plus copies; two-round holds u8
     bins + one 16K-row chunk)."""
-    n, f = 400_000, 60
+    n, f = 300_000, 50
     path = str(tmp_path / "big.csv")
     _write_csv(path, n, f, seed=7)
     script = _RSS_SCRIPT.format(repo=os.path.dirname(_DIR),
                                 path=path, n=n)
-    out = subprocess.run([sys.executable, "-c", script],
-                         capture_output=True, text=True, timeout=900)
+    # under a loaded machine (parallel xdist workers) the subprocess
+    # can be slow or OOM-killed; retry once before judging
+    for attempt in range(2):
+        out = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True,
+                             timeout=1500)
+        if out.returncode == 0:
+            break
     assert out.returncode == 0, out.stderr[-2000:]
     p1, p2 = map(int, out.stdout.strip().split())
-    raw_mb = n * (f + 1) * 8 / 2 ** 20      # ~186 MB
+    raw_mb = n * (f + 1) * 8 / 2 ** 20      # ~117 MB
     saved_mb = (p2 - p1) / 1024             # ru_maxrss is KB on linux
     assert saved_mb > raw_mb / 2, (p1, p2, raw_mb)
