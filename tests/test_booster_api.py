@@ -224,3 +224,30 @@ def test_streaming_push_incomplete_raises():
     ds.push_rows(np.zeros((40, 3)), start_row=0)
     with pytest.raises(lgb.LightGBMError, match="unpushed"):
         ds.mark_finished()
+
+
+def test_single_row_predict_matches_batch():
+    """Single-row prediction (the reference's fast single-row path,
+    tests/cpp_tests/test_single_row.cpp pattern): a [1, F] predict must
+    equal the matching row of a batch predict, for raw score, leaf
+    index, and contributions."""
+    X, y = make_synthetic_binary(n=1500, f=7, seed=23)
+    X[::11, 2] = np.nan
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=8)
+    batch = bst.predict(X[:32])
+    batch_raw = bst.predict(X[:32], raw_score=True)
+    batch_leaf = bst.predict(X[:32], pred_leaf=True)
+    batch_contrib = bst.predict(X[:32], pred_contrib=True)
+    for i in (0, 7, 11, 31):
+        row = X[i:i + 1]
+        np.testing.assert_allclose(bst.predict(row), batch[i:i + 1],
+                                   rtol=1e-7)
+        np.testing.assert_allclose(bst.predict(row, raw_score=True),
+                                   batch_raw[i:i + 1], rtol=1e-7)
+        np.testing.assert_array_equal(
+            bst.predict(row, pred_leaf=True), batch_leaf[i:i + 1])
+        np.testing.assert_allclose(
+            bst.predict(row, pred_contrib=True),
+            batch_contrib[i:i + 1], rtol=1e-6, atol=1e-9)

@@ -77,6 +77,10 @@ def test_two_round_sampled_mappers_close(tmp_path):
 _RSS_SCRIPT = r"""
 import gc, os, resource, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the parent pytest worker exports an 8-virtual-device XLA_FLAGS
+# (conftest); inheriting it balloons the subprocess's jax baseline to
+# GBs and drowns the loader-peak signal
+os.environ["XLA_FLAGS"] = ""
 sys.path.insert(0, {repo!r})
 import numpy as np
 import lightgbm_tpu as lgb
