@@ -1,0 +1,67 @@
+# Train/predict from R via reticulate.
+#
+# The R-package de-scope (docs/PARITY.md §2.7): the reference's
+# R-package/ is a 1:1 FFI wrapper over the C API (R-package/src/
+# lightgbm_R.cpp), an ABI boundary this framework does not have.
+# R users reach the FULL surface through reticulate instead — this
+# script is the working recipe.
+#
+# Requirements: install.packages("reticulate"); a python with jax.
+# Run:  LIGHTGBM_TPU_PATH=/root/repo Rscript train_predict.R
+
+library(reticulate)
+
+# point reticulate at the repo (or pip-install the package and skip)
+repo <- Sys.getenv("LIGHTGBM_TPU_PATH", unset = "/root/repo")
+sys <- import("sys")
+sys$path$insert(0L, repo)
+
+# force the host backend when no TPU is attached (optional)
+os <- import("os")
+os$environ$setdefault("JAX_PLATFORMS", "cpu")
+
+lgb <- import("lightgbm_tpu")
+np <- import("numpy")
+
+# -- data: R matrix -> numpy happens automatically ---------------------
+set.seed(7)
+n <- 2000L; f <- 10L
+X <- matrix(rnorm(n * f), nrow = n)
+coef <- rnorm(f)
+y <- as.numeric((X %*% coef + 0.3 * rnorm(n)) > 0)
+
+X_train <- X[1:1500, ]; y_train <- y[1:1500]
+X_valid <- X[1501:n, ]; y_valid <- y[1501:n]
+
+# -- Dataset / train: same API as Python -------------------------------
+dtrain <- lgb$Dataset(X_train, label = y_train)
+dvalid <- lgb$Dataset(X_valid, label = y_valid, reference = dtrain)
+
+record <- dict()
+params <- dict(objective = "binary", metric = "auc",
+               num_leaves = 31L, learning_rate = 0.1, verbosity = -1L)
+bst <- lgb$train(params, dtrain, num_boost_round = 30L,
+                 valid_sets = list(dvalid),
+                 callbacks = list(lgb$record_evaluation(record)))
+
+auc <- record[["valid_0"]][["auc"]]
+cat(sprintf("final valid AUC: %.4f\n", auc[[length(auc)]]))
+
+# -- predict + save/load round-trip ------------------------------------
+pred <- bst$predict(X_valid)
+cat(sprintf("pred[1:3]: %s\n", paste(round(pred[1:3], 4), collapse = " ")))
+
+model_path <- file.path(tempdir(), "model.txt")
+bst$save_model(model_path)
+bst2 <- lgb$Booster(model_file = model_path)
+pred2 <- bst2$predict(X_valid)
+stopifnot(max(abs(pred - pred2)) < 1e-6)
+
+# -- sklearn-style wrapper also works ----------------------------------
+clf <- lgb$LGBMClassifier(n_estimators = 10L, num_leaves = 15L,
+                          verbosity = -1L)
+clf$fit(X_train, y_train)
+acc <- mean((clf$predict(X_valid) > 0.5) == (y_valid > 0.5))
+cat(sprintf("sklearn-wrapper accuracy: %.3f\n", acc))
+
+cat("R-reticulate example OK\n")

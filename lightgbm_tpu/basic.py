@@ -59,7 +59,18 @@ def _load_text_file(path: str, cfg: Config
     label_col = 0
     lc = str(cfg.label_column)
     if lc.startswith("name:"):
-        pass  # resolved via header below
+        # resolve against the header line (Config::label_column name:
+        # form, config.h; DataLoader maps it through the header)
+        want = lc[len("name:"):]
+        if not header:
+            raise LightGBMError(
+                "label_column='name:...' requires header=true")
+        names = [t.strip() for t in
+                 (first.split(sep) if sep else first.split())]
+        if want not in names:
+            raise LightGBMError(
+                f"label column '{want}' not found in header: {names}")
+        label_col = names.index(want)
     elif lc != "":
         label_col = int(lc)
 
@@ -138,7 +149,24 @@ def _two_round_load(path: str, cfg: Config, cat_idx_set,
     header = bool(cfg.header)
     label_col = 0
     lc = str(cfg.label_column)
-    if lc and not lc.startswith("name:"):
+    if lc.startswith("name:"):
+        # resolve against the header HERE rather than deferring to the
+        # eager loader: a user sets two_round precisely because the
+        # file dwarfs host RAM, so falling back to the full-matrix
+        # loader would defeat the mode on exactly its target input.
+        # Silently assuming column 0 trained on a feature as the
+        # label (ADVICE r4).
+        want = lc[len("name:"):]
+        if not header:
+            raise LightGBMError(
+                "label_column='name:...' requires header=true")
+        names = [t.strip() for t in
+                 (first.split(sep) if sep else first.split())]
+        if want not in names:
+            raise LightGBMError(
+                f"label column '{want}' not found in header: {names}")
+        label_col = names.index(want)
+    elif lc:
         label_col = int(lc)
 
     # ---- round 1: count + reservoir sample ----
@@ -620,7 +648,15 @@ class Dataset:
             elif hasattr(data, "tocsr") or hasattr(data, "toarray"):
                 X = np.asarray(data.todense(), dtype=np.float64)
             elif isinstance(data, np.ndarray):
-                X = np.asarray(data, dtype=np.float64)
+                # float32 is kept WITHOUT a whole-matrix float64 copy:
+                # every consumer (find_bin, bin_values, _raw_numeric)
+                # casts per column, so upcasting here would only
+                # double peak host RSS — at Allstate-bench scale
+                # (2M x 4228) that is the difference between ~44 GB
+                # and OOM. Mirrors the reference accepting float32
+                # buffers (C_API_DTYPE_FLOAT32, c_api.h).
+                X = data if data.dtype == np.float32 \
+                    else np.asarray(data, dtype=np.float64)
                 if X.ndim == 1:
                     X = X[:, None]
             elif isinstance(data, (list, tuple)):

@@ -229,15 +229,25 @@ class GBDTBooster:
         # the per-leaf histogram cache all scale with #bundles ---------
         self.bundle = None
         self._bundle_dev = None
+        # single source for the distributed dispatch decision — the
+        # EFB gate below and the mesh setup further down must agree
         want_dp = (cfg.tree_learner in ("data", "feature", "voting")
                    or cfg.num_devices > 1)
+        dp_active = want_dp and len(jax.devices()) > 1
+        dp_mode = {"feature": "feature",
+                   "voting": "voting"}.get(cfg.tree_learner, "data")
+        # bundling is a dataset property that sits below the parallel
+        # layer (feature_group.h:26): in data-parallel mode bundle
+        # columns shard by rows and their histograms psum like any
+        # other column. feature/voting modes still assume per-device
+        # column ownership the bundled search doesn't honor yet.
         plain = (self.monotone is None and self.feat_is_cat is None
                  and self.interaction_groups is None
                  and self.forced is None and not self.cegb_enabled
                  and cfg.feature_fraction_bynode >= 1.0
                  and cfg.path_smooth <= 0.0 and not cfg.linear_tree
                  and grower == "compact"
-                 and not (want_dp and len(jax.devices()) > 1))
+                 and (not dp_active or dp_mode == "data"))
         if cfg.enable_bundle and plain:
             binfo = ds.bundles(cfg)
             if binfo is not None:
@@ -292,17 +302,13 @@ class GBDTBooster:
         self.mesh = None
         self._pad = 0
         self._grow_fn = None
-        ndev = len(jax.devices())
-        want_dp = (cfg.tree_learner in ("data", "feature", "voting")
-                   or cfg.num_devices > 1)
-        if want_dp and ndev > 1 and self.cegb_enabled:
+        if dp_active and self.cegb_enabled:
             raise ValueError("CEGB is not supported with multi-device "
                              "training yet")
-        if want_dp and ndev > 1:
+        if dp_active:
             from ..parallel.data_parallel import make_dp_grow_fn
             from ..parallel.mesh import make_mesh, pad_rows
-            mode = {"feature": "feature",
-                    "voting": "voting"}.get(cfg.tree_learner, "data")
+            mode = dp_mode
             if mode == "voting" and (self.forced is not None
                                      or self.cegb_enabled):
                 raise ValueError(
@@ -335,7 +341,8 @@ class GBDTBooster:
                 cfg.use_quantized_grad and cfg.stochastic_rounding,
                 self.interaction_groups is not None,
                 self.forced is not None,
-                cfg.feature_fraction_bynode < 1.0)
+                cfg.feature_fraction_bynode < 1.0,
+                has_bundle=self.bundle is not None)
 
         seed = cfg.seed if cfg.seed is not None else 0
         self._base_key = jax.random.PRNGKey(seed)
@@ -843,6 +850,8 @@ class GBDTBooster:
                     args = args + self.forced
                 if node_key is not None:
                     args = args + (jax.random.fold_in(node_key, k),)
+                if self._bundle_dev is not None:
+                    args = args + self._bundle_dev
                 with timed("tree_learner/grow"):
                     dev_tree, row_leaf = self._grow_fn(*args)
                 row_leaf = row_leaf[: self.n]

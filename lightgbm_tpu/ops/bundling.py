@@ -94,7 +94,7 @@ def _eligible(mappers, bins: np.ndarray) -> np.ndarray:
 
 def build_bundles(bins: np.ndarray, mappers,
                   max_positions: int = 255,
-                  sample_rows: int = 32768,
+                  sample_rows: int = 200_000,
                   sparse_threshold: float = 0.8,
                   seed: int = 0) -> Optional[BundleInfo]:
     """Greedy bundling over the binned matrix.
@@ -122,19 +122,36 @@ def build_bundles(bins: np.ndarray, mappers,
     rs = np.random.RandomState(seed)
     idx = rs.choice(n, size=min(n, sample_rows), replace=False) \
         if n > sample_rows else np.arange(n)
-    sample = bins[idx]                      # [S, F]
-    nz = sample != 0                        # [S, F]
-    density = nz.mean(axis=0)
+    # feature-major contiguous nonzero masks: the greedy loop reads
+    # per-FEATURE vectors thousands of times, and a column slice of
+    # the row-major [S, F] matrix is one cache miss per element — at
+    # Allstate width (4228 features) that turned bundling into
+    # minutes of pointer-chasing (measured >9 min at S=32K; ~seconds
+    # after this transpose). Masks live BIT-PACKED (u8 words +
+    # popcount): 8x smaller and AND/OR run on words, which is what
+    # makes the larger default sample affordable. The sample must be
+    # LARGE because sampled-conflict counts gate merges: at S=32K a
+    # truly-conflicting cross-block pair (E[joint] ~ 2 rows) shows
+    # zero sampled conflicts ~14% of the time, so every group absorbs
+    # foreign members early and the packing shatters (measured 659
+    # bundles on Allstate-shaped data vs ~33 at S=200K). 200K matches
+    # the reference's bin_construct_sample_cnt default it feeds
+    # FindGroups with (dataset_loader.cpp).
+    nzT = np.ascontiguousarray((bins[idx] != 0).T)   # [F, S] bool
+    density = nzT.mean(axis=1)
     eligible = _eligible(mappers, bins) & (density <= 1 - sparse_threshold)
+    S = nzT.shape[1]
+    nzP = np.packbits(nzT, axis=1)                   # [F, ceil(S/8)] u8
+    del nzT
 
     nbins = np.array([m.num_bins for m in mappers], np.int64)
-    S = sample.shape[0]
     # per-bundle conflict budget (single_val_max_conflict_cnt,
     # src/io/dataset.cpp:115): rows where two members are both nonzero
     # are tolerated up to this count — the later member's value wins in
     # the shared column, a bounded approximation the reference accepts
     conflict_budget = int(S * MAX_CONFLICT_FRACTION)
-    order = np.argsort(-nz.sum(axis=0))     # dense first (reference)
+    popcounts = np.bitwise_count(nzP).sum(axis=1)
+    order = np.argsort(-popcounts)          # dense first (reference)
     groups: List[List[int]] = []
     group_nz: List[np.ndarray] = []         # aggregated nonzero masks
     group_pos: List[int] = []               # occupied positions (1 + ...)
@@ -144,30 +161,90 @@ def build_bundles(bins: np.ndarray, mappers,
             continue
         placed = False
         width = int(nbins[j]) - 1
-        # first-fit over ALL groups. The reference samples at most
-        # max_search_group=100 random candidates (dataset.cpp:113) as a
-        # 100K+-feature scale heuristic, but sampling can miss the one
-        # compatible group and shatter the packing (measured: a 160-
-        # block one-hot matrix went 186 -> 1853 columns); the exact
-        # scan is cheap because eligibility already filters to sparse
-        # features and the hit is found early for block-sparse data.
+        nz_j = nzP[j]
+        # first-fit over ALL groups, zero-conflict placements first.
+        # The reference samples at most max_search_group=100 random
+        # candidates (dataset.cpp:113) as a 100K+-feature scale
+        # heuristic, but sampling can miss the one compatible group
+        # and shatter the packing (measured: a 160-block one-hot
+        # matrix went 186 -> 1853 columns); the exact scan is cheap
+        # because eligibility already filters to sparse features.
+        # Zero-conflict-first matters on block-sparse data: a greedy
+        # single pass lets a cross-block feature spend a group's tiny
+        # conflict budget (S/10000) early, locking out the group's own
+        # block and shattering the packing (measured: Allstate-shaped
+        # 4228 features packed to 719 bundles single-pass vs ~33 with
+        # exclusive-first placement).
+        cnts = []
         for gi in range(len(groups)):
             if group_pos[gi] + width > max_positions:
+                cnts.append(None)
                 continue
-            cnt = int(np.sum(group_nz[gi] & nz[:, j]))
-            if group_conf[gi] + cnt > conflict_budget:
-                continue                    # over the conflict budget
+            cnt = int(np.bitwise_count(group_nz[gi] & nz_j).sum())
+            cnts.append(cnt)
+            if cnt == 0:
+                placed = True
+                break
+        if not placed:
+            for gi, cnt in enumerate(cnts):
+                if cnt is not None and \
+                        group_conf[gi] + cnt <= conflict_budget:
+                    placed = True
+                    break
+        if placed:
             groups[gi].append(int(j))
-            group_nz[gi] |= nz[:, j]
+            group_nz[gi] |= nz_j
             group_pos[gi] += width
-            group_conf[gi] += cnt
-            placed = True
-            break
+            group_conf[gi] += (cnt if cnt else 0)
         if not placed and width + 1 <= max_positions:
             groups.append([int(j)])
-            group_nz.append(nz[:, j].copy())
+            group_nz.append(nz_j.copy())
             group_pos.append(1 + width)
             group_conf.append(0)
+
+    # group-consolidation pass: per-feature first-fit still fragments
+    # block-sparse data (same-block features scatter into whichever
+    # small mixed group shows zero SAMPLED conflicts by luck, and those
+    # groups then close to everything as E[conflicts] grows with
+    # membership — measured: Allstate-shaped 4228 features ended at
+    # 659 groups). Merging whole GROUPS by their aggregated masks
+    # collapses same-block fragments (exact zero conflicts), again
+    # zero-conflict placements first; merged groups share the zero
+    # position, so positions add as (pos - 1).
+    cons: List[List[int]] = []
+    cons_nz: List[np.ndarray] = []
+    cons_pos: List[int] = []
+    cons_conf: List[int] = []
+    for g, gnz, gpos, gconf in zip(groups, group_nz, group_pos,
+                                   group_conf):
+        placed = False
+        cnts2 = []
+        for ci in range(len(cons)):
+            if cons_pos[ci] + gpos - 1 > max_positions:
+                cnts2.append(None)
+                continue
+            cnt = int(np.bitwise_count(cons_nz[ci] & gnz).sum())
+            cnts2.append(cnt)
+            if cnt == 0 and cons_conf[ci] + gconf <= conflict_budget:
+                placed = True
+                break
+        if not placed:
+            for ci, cnt in enumerate(cnts2):
+                if cnt is not None and \
+                        cons_conf[ci] + gconf + cnt <= conflict_budget:
+                    placed = True
+                    break
+        if placed:
+            cons[ci].extend(g)
+            cons_nz[ci] |= gnz
+            cons_pos[ci] += gpos - 1
+            cons_conf[ci] += gconf + (cnt if cnt else 0)
+        else:
+            cons.append(list(g))
+            cons_nz.append(gnz.copy())
+            cons_pos.append(gpos)
+            cons_conf.append(gconf)
+    groups = cons
 
     multi = [g for g in groups if len(g) > 1]
     if not multi:
@@ -201,17 +278,23 @@ def build_bundles(bins: np.ndarray, mappers,
     B = max(widths)
 
     dtype = np.uint8 if B <= 256 else np.uint16
-    out = np.zeros((n, G), dtype)
+    # one blocked transpose instead of F strided column walks over the
+    # row-major [n, F] matrix (each of those is a cache miss per
+    # element at Allstate width); outT is also what the engine
+    # ultimately wants (it uploads bins_bundled.T)
+    binsT = np.ascontiguousarray(bins.T)    # [F, n]
+    outT = np.zeros((G, n), dtype)
     for gi, g in enumerate(final_groups):
         if len(g) == 1:
-            out[:, gi] = bins[:, g[0]].astype(dtype)
+            outT[gi] = binsT[g[0]].astype(dtype)
         else:
             col = np.zeros(n, np.int64)
             for j in g:
-                bj = bins[:, j].astype(np.int64)
+                bj = binsT[j].astype(np.int64)
                 sel = bj != 0
                 col[sel] = offset_of[j] + bj[sel] - 1
-            out[:, gi] = col.astype(dtype)
+            outT[gi] = col.astype(dtype)
+    out = outT.T
 
     from .binning import MissingType
     nanb = np.array([int(nbins[j]) - 1

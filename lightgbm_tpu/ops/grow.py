@@ -655,13 +655,21 @@ def _grow_compact_impl(cfg: GrowConfig,
 
     bundled = cfg.bundled and bundle_arrays is not None
     if bundled:
+        # Bundling sits BELOW the parallel layer in data-parallel mode,
+        # exactly like FeatureGroup in the reference (feature_group.h:26
+        # is a dataset property every learner consumes): bundle columns
+        # are just columns — rows shard, bundle histograms psum, and the
+        # replicated bundled search is deterministic per device.
+        # feature/voting stay gated: their searches assume per-device
+        # COLUMN ownership / local ballots, which the bundled search
+        # (global [G,B] hist + member remap) does not yet honor.
         if (cfg.cegb or interaction_groups is not None
                 or forced is not None
                 or has_mono or use_bynode or smoothing
-                or feat_is_cat is not None or cfg.axis_name is not None):
+                or feat_is_cat is not None or fp or vp):
             raise NotImplementedError(
-                "EFB bundling currently supports plain single-device "
-                "training only (gbdt.py gates the combinations)")
+                "EFB bundling supports plain and data-parallel training "
+                "only (gbdt.py gates the other combinations)")
         (bundle_of, offset_of, bundle_is_direct, member_at, tloc_at,
          end_at, bundle_nanpos, bundle_nan_at) = bundle_arrays
 
@@ -1551,6 +1559,16 @@ def _grow_compact_impl(cfg: GrowConfig,
                 bins2, pay2, leaf_buf, lbegin, lcount, leaf2slot = \
                     pool_ctx
                 slot = leaf2slot[l]
+                # COLLECTIVE-IN-COND INVARIANT (data-parallel): the
+                # miss branch's window_hist ends in hist_psum, i.e. a
+                # collective inside lax.cond. This is deadlock-free
+                # iff the predicate is bit-identical on every device —
+                # which holds because leaf2slot is pool state derived
+                # ONLY from the replicated tree/argmax sequence (the
+                # hit branch's cached hists are likewise already
+                # globally reduced). Never feed device-dependent
+                # inputs into the pool bookkeeping: a divergent
+                # predicate would hang all hosts, not raise.
                 hist = lax.cond(
                     slot >= 0,
                     lambda: lax.dynamic_index_in_dim(

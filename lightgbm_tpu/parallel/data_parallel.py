@@ -36,10 +36,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax>=0.4.35
-    from jax.experimental.shard_map import shard_map
+try:  # jax>=0.8: jax.shard_map, replication checking via check_vma
+    from jax import shard_map as _shard_map
+
+    def shard_map(fn, mesh, in_specs, out_specs, check_rep):
+        return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_rep)
 except ImportError:  # pragma: no cover
-    from jax.shard_map import shard_map
+    from jax.experimental.shard_map import shard_map
 
 from ..ops.grow import GrowConfig, grow_tree_impl
 
@@ -49,7 +53,8 @@ __all__ = ["make_dp_grow_fn"]
 @functools.lru_cache(maxsize=32)
 def _build(cfg: GrowConfig, mesh: Mesh, has_monotone: bool, has_cat: bool,
            has_quant_key: bool, has_interaction: bool = False,
-           has_forced: bool = False, has_node_key: bool = False):
+           has_forced: bool = False, has_node_key: bool = False,
+           has_bundle: bool = False):
     axis = mesh.axis_names[0]
     cfg = cfg._replace(axis_name=axis)
     if cfg.parallel_mode == "feature":
@@ -67,7 +72,12 @@ def _build(cfg: GrowConfig, mesh: Mesh, has_monotone: bool, has_cat: bool,
                                     + int(has_quant_key)
                                     + int(has_interaction)
                                     + 3 * int(has_forced)
-                                    + int(has_node_key))
+                                    + int(has_node_key)
+                                    # bundle metadata (8 host-built
+                                    # arrays, ops/bundling.py) is a
+                                    # dataset property — replicated,
+                                    # like the bin-count metadata
+                                    + 8 * int(has_bundle))
     out_specs = (rep, rowspec)  # tree replicated, row_leaf row-layout
 
     def fn(bins_T, grad, hess, row_w, fmask, fnb, fnan, *rest):
@@ -81,9 +91,10 @@ def _build(cfg: GrowConfig, mesh: Mesh, has_monotone: bool, has_cat: bool,
             forced = tuple(rest[:3])
             rest = rest[3:]
         nkey = rest.pop(0) if has_node_key else None
+        bundle = tuple(rest[:8]) if has_bundle else None
         return grow_tree_impl(cfg, bins_T, grad, hess, row_w, fmask,
                               fnb, fnan, mono, cat, qkey, groups, forced,
-                              None, nkey)
+                              None, nkey, bundle)
 
     sharded = shard_map(fn, mesh=mesh, in_specs=in_specs,
                         out_specs=out_specs, check_rep=False)
@@ -95,10 +106,12 @@ def make_dp_grow_fn(cfg: GrowConfig, mesh: Mesh,
                     has_quant_key: bool = False,
                     has_interaction: bool = False,
                     has_forced: bool = False,
-                    has_node_key: bool = False):
+                    has_node_key: bool = False,
+                    has_bundle: bool = False):
     """Returns grow(bins_T, grad, hess, row_w, fmask, fnb, fnan[, mono]
-    [, feat_is_cat][, quant_key][, groups][, forced...][, node_key])
-    running data-parallel over ``mesh``. Row inputs must be padded to a
-    multiple of the device count (pad rows carry row_weight 0)."""
+    [, feat_is_cat][, quant_key][, groups][, forced...][, node_key]
+    [, bundle x8]) running data-parallel over ``mesh``. Row inputs must
+    be padded to a multiple of the device count (pad rows carry
+    row_weight 0)."""
     return _build(cfg, mesh, has_monotone, has_cat, has_quant_key,
-                  has_interaction, has_forced, has_node_key)
+                  has_interaction, has_forced, has_node_key, has_bundle)

@@ -115,3 +115,29 @@ def test_linear_tree_predictions_still_exact():
     Xn[:50, 0] = np.nan
     pn = bst.predict(Xn)
     assert np.all(np.isfinite(pn))
+
+
+def test_two_round_name_label_column_defers_to_eager(tmp_path):
+    """two_round + ``label_column=name:<col>`` must NOT silently treat
+    column 0 as the label (ADVICE r4, basic.py:141): the two-round
+    fast path defers to the eager loader's header resolution. The
+    label lives in the LAST column here, so training on column 0
+    would produce garbage."""
+    rs = np.random.RandomState(4)
+    n, f = 4000, 5
+    X = rs.randn(n, f)
+    y = ((X[:, 1] + 0.5 * X[:, 3]) > 0).astype(float)
+    path = tmp_path / "named.csv"
+    cols = [f"feat{j}" for j in range(f)] + ["target"]
+    data = np.column_stack([X, y])
+    with open(path, "w") as fh:
+        fh.write(",".join(cols) + "\n")
+        for row in data:
+            fh.write(",".join(f"{v:.6f}" for v in row) + "\n")
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "header": True, "label_column": "name:target",
+              "two_round": True}
+    bst = lgb.train(dict(params), lgb.Dataset(str(path), params=params),
+                    num_boost_round=10)
+    acc = np.mean((bst.predict(X) > 0.5) == (y > 0.5))
+    assert acc > 0.9, acc

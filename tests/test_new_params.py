@@ -190,3 +190,16 @@ def test_auc_mu_through_train_metric():
     curve = evals["train"]["auc_mu"]
     assert curve[-1] > 0.8
     assert curve[-1] >= curve[0] - 1e-9
+
+
+def test_parameters_doc_in_sync():
+    """docs/PARAMETERS.md is generated from config.py (the reference's
+    parameter-generator.py pattern); it must never drift."""
+    import subprocess
+    import sys
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join("tools", "gen_parameters_doc.py"),
+         "--check"], cwd=repo, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,78 @@
+"""The R/reticulate de-scope must be evidence, not assertion (VERDICT
+r4 #9): the examples/r_reticulate/train_predict.R recipe is executed
+for real when an R toolchain exists, and its Python API surface is
+validated against the package either way so the script cannot rot.
+"""
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "examples", "r_reticulate", "train_predict.R")
+
+
+def test_r_script_uses_only_real_api():
+    """Every `lgb$name` symbol the R script touches must exist on the
+    package — renames/removals surface here even without R installed."""
+    with open(SCRIPT) as f:
+        src = f.read()
+    symbols = set(re.findall(r"lgb\$(\w+)", src))
+    assert symbols, "script should exercise the lgb API"
+    missing = [s for s in symbols if not hasattr(lgb, s)]
+    assert not missing, f"R script references unknown API: {missing}"
+
+
+def test_r_script_call_sequence_mirrored_in_python():
+    """Mirror of the R script's exact call sequence with the argument
+    spellings reticulate would pass (keyword names, R integers ->
+    Python ints, R matrix -> numpy float64). Keep in lockstep with
+    train_predict.R."""
+    rs = np.random.RandomState(7)
+    n, f = 2000, 10
+    X = rs.randn(n, f)
+    y = ((X @ rs.randn(f) + 0.3 * rs.randn(n)) > 0).astype(np.float64)
+    X_train, y_train = X[:1500], y[:1500]
+    X_valid, y_valid = X[1500:], y[1500:]
+
+    dtrain = lgb.Dataset(X_train, label=y_train)
+    dvalid = lgb.Dataset(X_valid, label=y_valid, reference=dtrain)
+    record = {}
+    params = dict(objective="binary", metric="auc", num_leaves=31,
+                  learning_rate=0.1, verbosity=-1)
+    bst = lgb.train(params, dtrain, num_boost_round=30,
+                    valid_sets=[dvalid],
+                    callbacks=[lgb.record_evaluation(record)])
+    auc = record["valid_0"]["auc"]
+    assert auc[-1] > 0.8
+
+    pred = bst.predict(X_valid)
+    model_path = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "r_example_model.txt")
+    bst.save_model(model_path)
+    bst2 = lgb.Booster(model_file=model_path)
+    assert np.abs(pred - bst2.predict(X_valid)).max() < 1e-6
+
+    clf = lgb.LGBMClassifier(n_estimators=10, num_leaves=15,
+                             verbosity=-1)
+    clf.fit(X_train, y_train)
+    acc = np.mean((clf.predict(X_valid) > 0.5) == (y_valid > 0.5))
+    assert acc > 0.8
+
+
+@pytest.mark.skipif(shutil.which("Rscript") is None,
+                    reason="no R toolchain in this image")
+def test_r_script_runs_end_to_end(tmp_path):
+    env = dict(os.environ, LIGHTGBM_TPU_PATH=REPO,
+               RETICULATE_PYTHON=sys.executable)
+    r = subprocess.run(["Rscript", SCRIPT], env=env, timeout=600,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "R-reticulate example OK" in r.stdout

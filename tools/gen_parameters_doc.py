@@ -1,0 +1,149 @@
+"""Generate docs/PARAMETERS.md from the Config dataclass.
+
+The reference generates docs/Parameters.rst from config.h's annotated
+struct via .ci/parameter-generator.py (config.h:1-10 header comment) —
+the single-source-of-truth pattern this framework keeps: the dataclass
+in ``lightgbm_tpu/config.py`` is the one place parameter names,
+defaults, aliases and bounds live, and this script renders them.
+
+Usage:  python tools/gen_parameters_doc.py [--check]
+  --check: exit 1 if docs/PARAMETERS.md is out of sync (the
+           tests/test_new_params.py sync test runs this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from lightgbm_tpu.config import ALIASES, Config  # noqa: E402
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+OUT = os.path.join(REPO, "docs", "PARAMETERS.md")
+
+_SECTION_RE = re.compile(r"^\s*#\s*----\s*(.+?)\s*----\s*$")
+_FIELD_RE = re.compile(r"^\s{4}(\w+)\s*:\s*[\w\[\]\., ]+\s*(?:=|$)")
+
+
+def _field_sections():
+    """Map field -> section title by scanning the dataclass source for
+    ``# ---- section ----`` markers (comments aren't in the AST)."""
+    src_path = os.path.join(REPO, "lightgbm_tpu", "config.py")
+    with open(src_path) as f:
+        lines = f.readlines()
+    sections = {}
+    current = "core"
+    in_class = False
+    for ln in lines:
+        if ln.startswith("class Config"):
+            in_class = True
+            continue
+        if not in_class:
+            continue
+        if ln.startswith("    _BOUNDS"):
+            break
+        m = _SECTION_RE.match(ln)
+        if m:
+            current = m.group(1)
+            continue
+        m = _FIELD_RE.match(ln)
+        if m and not ln.strip().startswith("#"):
+            sections[m.group(1)] = current
+    return sections
+
+
+def _fmt_default(v):
+    if isinstance(v, str):
+        return f'`"{v}"`'
+    if isinstance(v, bool):
+        return f"`{str(v).lower()}`"
+    if isinstance(v, (list, dict)):
+        return "`[]`" if v == [] else f"`{v}`"
+    return f"`{v}`"
+
+
+def _fmt_bounds(spec):
+    lo, hi = spec[0], spec[1]
+    strict = len(spec) > 2 and spec[2] == "gt"
+    parts = []
+    if lo is not None:
+        parts.append(f"{'>' if strict else '>='} {lo}")
+    if hi is not None:
+        parts.append(f"<= {hi}")
+    return ", ".join(parts) if parts else ""
+
+
+def render() -> str:
+    sections = _field_sections()
+    rev_alias = {}
+    for alias, canon in ALIASES.items():
+        rev_alias.setdefault(canon, []).append(alias)
+
+    by_section = {}
+    for f in dataclasses.fields(Config):
+        if f.name == "extra":
+            continue  # internal catch-all, not a parameter
+        sec = sections.get(f.name, "core")
+        by_section.setdefault(sec, []).append(f)
+
+    lines = [
+        "# Parameters",
+        "",
+        "Generated from `lightgbm_tpu/config.py` (the single source of",
+        "truth — the reference generates docs/Parameters.rst from",
+        "config.h the same way, via `.ci/parameter-generator.py`).",
+        "",
+        "Do NOT edit by hand; run `python tools/gen_parameters_doc.py`.",
+        "",
+    ]
+    bounds = Config._BOUNDS
+    for sec, fields_ in by_section.items():
+        lines.append(f"## {sec}")
+        lines.append("")
+        lines.append("| parameter | default | constraints | aliases |")
+        lines.append("|---|---|---|---|")
+        for f in fields_:
+            if f.default is not dataclasses.MISSING:
+                dflt = _fmt_default(f.default)
+            elif f.default_factory is not dataclasses.MISSING:
+                dflt = _fmt_default(f.default_factory())
+            else:
+                dflt = ""
+            b = _fmt_bounds(bounds[f.name]) if f.name in bounds else ""
+            al = ", ".join(f"`{a}`" for a in
+                           sorted(rev_alias.get(f.name, [])))
+            lines.append(f"| `{f.name}` | {dflt} | {b} | {al} |")
+        lines.append("")
+    lines.append(f"Total: {sum(len(v) for v in by_section.values())} "
+                 f"parameters, {len(ALIASES)} aliases.")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main():
+    text = render()
+    if "--check" in sys.argv:
+        try:
+            with open(OUT) as f:
+                on_disk = f.read()
+        except OSError:
+            print(f"{OUT} missing; run tools/gen_parameters_doc.py")
+            sys.exit(1)
+        if on_disk != text:
+            print(f"{OUT} is OUT OF SYNC with config.py; "
+                  "run tools/gen_parameters_doc.py")
+            sys.exit(1)
+        print("PARAMETERS.md in sync")
+        return
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        f.write(text)
+    print(f"wrote {OUT}")
+
+
+if __name__ == "__main__":
+    main()
