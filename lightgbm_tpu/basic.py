@@ -1022,12 +1022,19 @@ class Dataset:
         self.construct()
         if not getattr(cfg, "enable_bundle", True):
             return None
+        cap = getattr(cfg, "max_cat_to_onehot", 4)
         cached = getattr(self, "_bundle_info", None)
+        # cache key includes the one-hot cap: it gates cat-member
+        # ELIGIBILITY, so a stale bundle under a different cap would
+        # leave wide cat members with zero split candidates
         if cached is not None and \
-                cached.bins_bundled.shape[0] == self._n:
+                cached.bins_bundled.shape[0] == self._n \
+                and getattr(self, "_bundle_cat_cap", None) == cap:
             return cached
         from .ops.bundling import build_bundles
-        self._bundle_info = build_bundles(self._bins, self.mappers)
+        self._bundle_info = build_bundles(
+            self._bins, self.mappers, max_cat_onehot=cap)
+        self._bundle_cat_cap = cap
         return self._bundle_info
 
     def device_raw(self):

@@ -241,7 +241,7 @@ class GBDTBooster:
         # columns shard by rows and their histograms psum like any
         # other column. feature/voting modes still assume per-device
         # column ownership the bundled search doesn't honor yet.
-        plain = (self.monotone is None and self.feat_is_cat is None
+        plain = (self.monotone is None
                  and self.interaction_groups is None
                  and self.forced is None and not self.cegb_enabled
                  and cfg.feature_fraction_bynode >= 1.0

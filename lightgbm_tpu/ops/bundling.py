@@ -68,23 +68,36 @@ class BundleInfo(NamedTuple):
                                   #   NaN bin (excluded from scans)
 
 
-def _eligible(mappers, bins: np.ndarray) -> np.ndarray:
-    """Features that may enter a multi-member bundle: numerical with
-    zero mapping to bin 0 (the shared default); a NaN bin is allowed
-    (handled by the dual-direction scan + nanpos/nan_at plumbing).
-    MissingType.ZERO members stay excluded: their missing bin IS the
-    shared default-0 position, which the per-member NaN-position
-    algebra (nan bin = last bin) cannot represent — they remain direct
-    singletons with the plain dual scan."""
+def _eligible(mappers, bins: np.ndarray,
+              max_cat_onehot: int = 4) -> np.ndarray:
+    """Features that may enter a multi-member bundle.
+
+    Numerical: zero maps to bin 0 (the shared default); a NaN bin is
+    allowed (handled by the dual-direction scan + nanpos/nan_at
+    plumbing). MissingType.ZERO members stay excluded: their missing
+    bin IS the shared default-0 position, which the per-member
+    NaN-position algebra (nan bin = last bin) cannot represent — they
+    remain direct singletons with the plain dual scan.
+
+    Categorical (round 5, FindGroups is type-blind — dataset.cpp):
+    bin 0 is the most-frequent category by construction
+    (_find_bin_categorical sorts by count), so position 0 = "member at
+    its dominant category" and the nonzero bins are the tail
+    categories. Only features in the ONE-HOT regime
+    (num_bins <= max_cat_to_onehot) may join: their bundled candidate
+    set (one-hot per category, incl. the reconstructed dominant) is
+    EXACTLY the plain search's — wider cats use the sorted-subset scan
+    and stay direct singleton columns, where that scan runs verbatim."""
     from .binning import BinType, MissingType
     F = bins.shape[1]
     ok = np.zeros(F, bool)
     for j, m in enumerate(mappers):
-        if m.bin_type != BinType.NUMERICAL:
+        if m.num_bins < 2:
+            continue
+        if m.bin_type == BinType.CATEGORICAL:
+            ok[j] = m.num_bins <= max_cat_onehot
             continue
         if m.missing_type == MissingType.ZERO:
-            continue
-        if m.num_bins < 2:
             continue
         if int(m.value_to_bin(np.zeros(1))[0]) != 0:
             continue
@@ -96,7 +109,8 @@ def build_bundles(bins: np.ndarray, mappers,
                   max_positions: int = 255,
                   sample_rows: int = 200_000,
                   sparse_threshold: float = 0.8,
-                  seed: int = 0) -> Optional[BundleInfo]:
+                  seed: int = 0,
+                  max_cat_onehot: int = 4) -> Optional[BundleInfo]:
     """Greedy bundling over the binned matrix.
 
     Merges tolerate up to ``S * MAX_CONFLICT_FRACTION`` conflicting
@@ -139,12 +153,21 @@ def build_bundles(bins: np.ndarray, mappers,
     # FindGroups with (dataset_loader.cpp).
     nzT = np.ascontiguousarray((bins[idx] != 0).T)   # [F, S] bool
     density = nzT.mean(axis=1)
-    eligible = _eligible(mappers, bins) & (density <= 1 - sparse_threshold)
+    eligible = _eligible(mappers, bins, max_cat_onehot) \
+        & (density <= 1 - sparse_threshold)
     S = nzT.shape[1]
     nzP = np.packbits(nzT, axis=1)                   # [F, ceil(S/8)] u8
     del nzT
 
+    from .binning import BinType
     nbins = np.array([m.num_bins for m in mappers], np.int64)
+    is_cat = np.array([m.bin_type == BinType.CATEGORICAL
+                       for m in mappers], bool)
+    # a categorical member reserves ONE extra position: its last
+    # category's one-hot candidate is a real split (not the degenerate
+    # all-left cut a numeric member parks there), so the next member's
+    # shared t=0 slot must not overwrite it
+    member_width = nbins - 1 + is_cat.astype(np.int64)
     # per-bundle conflict budget (single_val_max_conflict_cnt,
     # src/io/dataset.cpp:115): rows where two members are both nonzero
     # are tolerated up to this count — the later member's value wins in
@@ -160,7 +183,7 @@ def build_bundles(bins: np.ndarray, mappers,
         if not eligible[j]:
             continue
         placed = False
-        width = int(nbins[j]) - 1
+        width = int(member_width[j])
         nz_j = nzP[j]
         # first-fit over ALL groups, zero-conflict placements first.
         # The reference samples at most max_search_group=100 random
@@ -273,7 +296,7 @@ def build_bundles(bins: np.ndarray, mappers,
             for j in g:
                 bundle_of[j] = gi
                 offset_of[j] = off
-                off += int(nbins[j]) - 1
+                off += int(member_width[j])
             widths.append(off)
     B = max(widths)
 
@@ -297,8 +320,12 @@ def build_bundles(bins: np.ndarray, mappers,
     out = outT.T
 
     from .binning import MissingType
+    # cat members carry NO nan metadata: their NaN bin is just another
+    # category (the plain cat search has no dual missing-direction
+    # scan), routed by the membership mask like any other bin
     nanb = np.array([int(nbins[j]) - 1
-                     if mappers[j].missing_type == MissingType.NAN
+                     if (mappers[j].missing_type == MissingType.NAN
+                         and not is_cat[j])
                      else -1 for j in range(F)], np.int64)
     member_at = np.full((G, B), -1, np.int32)
     tloc_at = np.zeros((G, B), np.int32)

@@ -666,7 +666,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         if (cfg.cegb or interaction_groups is not None
                 or forced is not None
                 or has_mono or use_bynode or smoothing
-                or feat_is_cat is not None or fp or vp):
+                or fp or vp):
             raise NotImplementedError(
                 "EFB bundling supports plain and data-parallel training "
                 "only (gbdt.py gates the other combinations)")
@@ -706,7 +706,8 @@ def _grow_compact_impl(cfg: GrowConfig,
                                            tloc_at, end_at,
                                            bundle_is_direct,
                                            bundle_nanpos, bundle_nan_at,
-                                           fmask, p)
+                                           fmask, p, feat_is_cat,
+                                           feat_num_bins)
         if fp:
             # disjoint feature ownership over word-aligned windows: the
             # device's histogram covers ONLY its own Fl columns (built
@@ -1045,8 +1046,23 @@ def _grow_compact_impl(cfg: GrowConfig,
             right_multi = (col >= off + t) & (col <= off + nb - 2) \
                 & ~is_nanrow
             left_multi = jnp.where(is_nanrow, dl, ~right_multi)
-            return jnp.where(bundle_is_direct[f], left_direct,
+            gl_b = jnp.where(bundle_is_direct[f], left_direct,
                              left_multi)
+            if has_cat:
+                # categorical membership split: recover the member's
+                # LOCAL bin (direct columns store it verbatim; multi
+                # members map bins 1..nb-1 to [off, off+nb-2], rows
+                # outside the range sit at the member's bin 0), then
+                # route by the [B] membership mask like the plain path
+                local = jnp.where(
+                    bundle_is_direct[f], col,
+                    jnp.where((col >= off) & (col <= off + nb - 2),
+                              col - off + 1, 0))
+                cm_col = jnp.any(
+                    (local[:, None] == jnp.arange(B)[None, :])
+                    & cm[None, :], axis=1)
+                gl_b = jnp.where(isc, cm_col, gl_b)
+            return gl_b
         fsel = jnp.arange(F) == f
         col = jnp.max(jnp.where(fsel[None, :], blk_b, 0),
                       axis=1).astype(jnp.int32)

@@ -493,7 +493,10 @@ def find_best_split_bundled(hist: jnp.ndarray,
                             nanpos_at: jnp.ndarray,
                             nan_at: jnp.ndarray,
                             feature_mask: jnp.ndarray,
-                            p: SplitParams) -> SplitResult:
+                            p: SplitParams,
+                            feat_is_cat: jnp.ndarray | None = None,
+                            feat_num_bins: jnp.ndarray | None = None) \
+        -> SplitResult:
     """Best split over an EFB-bundled histogram (ops/bundling.py layout).
 
     Every candidate is one (bundle, position) cell:
@@ -508,6 +511,16 @@ def find_best_split_bundled(hist: jnp.ndarray,
     excluded from prefix sums and thresholds, and its mass
     (``nanpos_at``) joins whichever side the scanned direction sends
     missing rows to.
+
+    Categorical members (round 5; FindGroups is type-blind,
+    dataset.cpp): a bundled cat member is always in the one-hot regime
+    (bundling caps membership at max_cat_to_onehot), so its candidates
+    are one-hot per position — the position's own mass for tail
+    categories, and the reconstructed default (bin-0 = most-frequent
+    category) mass for t=0 — exactly the plain one-hot scan. Direct
+    singleton cat columns carry their histogram verbatim, so the full
+    plain machinery (_cat_split_eval: one-hot AND sorted-subset)
+    runs on them unchanged.
     """
     G, B, _ = hist.shape
     dtype = hist.dtype
@@ -533,6 +546,11 @@ def find_best_split_bundled(hist: jnp.ndarray,
         .reshape(G, B, 3)
     nan_stats = nan_stats * has_nan[:, :, None].astype(dtype)
 
+    if feat_is_cat is not None:
+        is_cat_pos = feat_is_cat[member_ix] & has_member   # [G, B]
+    else:
+        is_cat_pos = jnp.zeros((G, B), jnp.bool_)
+
     def eval_left(left, extra_valid):
         right = total[None, None, :] - left
         lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
@@ -555,15 +573,49 @@ def find_best_split_bundled(hist: jnp.ndarray,
     # cuts are pruned by the lc/rc validity checks).
     left1 = jnp.where(direct_pos[:, :, None], cum,
                       total[None, None, :] - (e - cum) - nan_stats)
-    g1 = eval_left(left1, jnp.ones((G, B), bool))
+    g1 = eval_left(left1, ~is_cat_pos)
     # direction 2: missing joins the left side (NaN members only)
     left2 = jnp.where(direct_pos[:, :, None], cum + nan_stats,
                       total[None, None, :] - (e - cum))
-    g2 = eval_left(left2, has_nan)
+    g2 = eval_left(left2, has_nan & ~is_cat_pos)
 
     parent_gain = leaf_gain(total[0], total[1], p)
     shift = parent_gain + p.min_gain_to_split
-    net = jnp.stack([g1 - shift, g2 - shift])          # [2, G, B]
+    stacks = [g1 - shift, g2 - shift]
+
+    if feat_is_cat is not None:
+        # member num_bins at each position (nb = end - pos + tloc + 1
+        # holds for both layouts: direct tloc == pos, end == nb - 1;
+        # multi pos == off + tloc - 1, end == off + nb - 2)
+        end_pos = end_at - (jnp.arange(G) * B)[:, None]
+        nb_at = end_pos - jnp.arange(B)[None, :] + tloc_at + 1
+        use_oh = nb_at <= p.max_cat_to_onehot
+        # one-hot family: tail category = the position's own mass;
+        # the default category (t=0) = the member's reconstructed
+        # bin-0 mass (for direct columns bin 0 is stored, h3 works)
+        left_oh = jnp.where(
+            ((tloc_at == 0) & ~direct_pos)[:, :, None],
+            total[None, None, :] - (e - cum), h3)
+        g_oh = eval_left(left_oh, is_cat_pos & use_oh)
+        # sorted-subset family for direct wide-cat columns: their rows
+        # of the bundle histogram ARE the feature histograms, so the
+        # plain machinery runs verbatim
+        direct_member = member_ix[:, 0]
+        col_cat = is_direct_f[direct_member] \
+            & feat_is_cat[direct_member] & (member_at[:, 0] >= 0)
+        col_nb = jnp.where(
+            col_cat,
+            feat_num_bins[direct_member] if feat_num_bins is not None
+            else 0, 0)
+        _, g_fwd, g_bwd, csum_f, csum_b, (inv, used, participate) = \
+            _cat_split_eval(h3, total[0], total[1], total[2],
+                            col_nb, p)
+        cmask2 = (col_cat & feature_mask[direct_member])[:, None]
+        g_fwd = jnp.where(cmask2, g_fwd, K_MIN_SCORE)
+        g_bwd = jnp.where(cmask2, g_bwd, K_MIN_SCORE)
+        stacks += [g_oh - shift, g_fwd - shift, g_bwd - shift]
+
+    net = jnp.stack(stacks)                       # [D, G, B]
     net = jnp.where(jnp.isfinite(net), net, K_MIN_SCORE)
 
     flat = jnp.argmax(net)
@@ -571,18 +623,43 @@ def find_best_split_bundled(hist: jnp.ndarray,
     g = (flat // B) % G
     pos = flat % B
     best = net.reshape(-1)[flat]
-    sel = jnp.where(d == 0, left1[g, pos], left2[g, pos])
+    if feat_is_cat is not None:
+        sel = jnp.stack([left1[g, pos], left2[g, pos], left_oh[g, pos],
+                         csum_f[g, pos], csum_b[g, pos]])[d]
+        is_cat_win = d >= 2
+        is_sorted_cat = d >= 3
+        bpos = jnp.arange(B)
+        oh_mask = bpos == tloc_at[g, pos]
+        fwd_mask = participate[g] & (inv[g] <= pos)
+        bwd_mask = participate[g] & (inv[g] >= used[g] - 1 - pos)
+        cat_mask = jnp.where(
+            is_cat_win,
+            jnp.where(d == 2, oh_mask,
+                      jnp.where(d == 3, fwd_mask, bwd_mask)),
+            jnp.zeros((B,), jnp.bool_))
+    else:
+        sel = jnp.where(d == 0, left1[g, pos], left2[g, pos])
+        is_cat_win = jnp.asarray(False)
+        is_sorted_cat = jnp.asarray(False)
+        cat_mask = jnp.zeros((B,), jnp.bool_)
     lgs, lhs, lcs = sel[0], sel[1], sel[2]
     rgs, rhs, rcs = total[0] - lgs, total[1] - lhs, total[2] - lcs
+    # sorted categorical outputs use l2 + cat_l2
+    # (feature_histogram.cpp:144)
+    p_cat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
+    lo = jnp.where(is_sorted_cat, leaf_output(lgs, lhs, p_cat),
+                   leaf_output(lgs, lhs, p))
+    ro = jnp.where(is_sorted_cat, leaf_output(rgs, rhs, p_cat),
+                   leaf_output(rgs, rhs, p))
     return SplitResult(
         gain=jnp.where(jnp.isfinite(best), best, K_MIN_SCORE)
         .astype(dtype),
         feature=member_at[g, pos].astype(jnp.int32),
         threshold_bin=tloc_at[g, pos].astype(jnp.int32),
         default_left=(d == 1),
-        is_cat=jnp.asarray(False),
-        cat_mask=jnp.zeros((B,), jnp.bool_),
+        is_cat=is_cat_win,
+        cat_mask=cat_mask,
         left_sum_g=lgs, left_sum_h=lhs, left_count=lcs,
         right_sum_g=rgs, right_sum_h=rhs, right_count=rcs,
-        left_output=leaf_output(lgs, lhs, p),
-        right_output=leaf_output(rgs, rhs, p))
+        left_output=lo,
+        right_output=ro)

@@ -269,3 +269,102 @@ def test_nan_member_boundary_slot_not_stale():
                         lgb.Dataset(X, label=y), num_boost_round=4)
     pp, pb = plain.predict(X), bundled.predict(X)
     assert np.mean((pp > 0.5) == (pb > 0.5)) > 0.995
+
+
+def _mixed_cat_onehot(n, groups=3, per_group=6, seed=9):
+    """Numerical one-hot blocks + a sparse small categorical (one-hot
+    regime, bundles) + a sparse wide categorical (sorted-subset
+    regime, stays a direct singleton) + dense numerics."""
+    rs = np.random.RandomState(seed)
+    X, y = _sparse_onehot(n, groups, per_group, seed=seed)
+    # two small cats with DISJOINT tail supports so they are mutually
+    # exclusive and can bundle with each other (a full one-hot block's
+    # union covers every row, so nothing else fits those bundles)
+    u = rs.rand(n)
+    small_a = np.full(n, 7.0)
+    ta = u < 0.12
+    small_a[ta] = rs.choice([1, 2, 3], size=int(ta.sum()))
+    small_b = np.zeros(n)
+    tb = (u >= 0.5) & (u < 0.62)
+    small_b[tb] = rs.choice([4, 5], size=int(tb.sum()))
+    # wide cat: dominant 0 (~84%), tail 1..9 — stays a direct column
+    wide = np.zeros(n)
+    tailw = rs.rand(n) < 0.16
+    wide[tailw] = rs.randint(1, 10, size=int(tailw.sum()))
+    Xm = np.column_stack([X, small_a, small_b, wide])
+    y = ((y > 0) ^ (small_a == 2) ^ (small_b == 5)
+         ^ ((wide >= 5) & tailw)).astype(float)
+    cat_idx = [X.shape[1], X.shape[1] + 1, X.shape[1] + 2]
+    return Xm, y, cat_idx
+
+
+def test_categorical_members_bundle_and_match_unbundled():
+    """Categorical EFB members (VERDICT r4 #7): type-blind bundling
+    like FindGroups (dataset.cpp). Small cats (one-hot regime) join
+    bundles with candidate-exact parity; wide cats stay direct
+    singleton columns where the sorted-subset scan runs verbatim.
+
+    Contract: the candidate SETS are exact, but a bundled member's
+    bin-0 stats are reconstructed as total - range in f32 (the
+    FixHistogram algebra), so gains can differ in the ~5th digit and
+    near-tie leaf-EXPANSION ORDER may permute node numbering (same
+    caveat the numeric NaN-member test documents). Assert
+    order-invariant equality: per-tree leaf counts, per-tree split
+    multisets, and prediction parity."""
+    X, y, cat_idx = _mixed_cat_onehot(4000)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "categorical_feature": cat_idx}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=8)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=8)
+    info = bundled._engine.bundle
+    assert info is not None, "bundling did not engage"
+    # the small cat must actually be INSIDE a multi-member bundle
+    small_cat_used = cat_idx[0]
+    in_multi = any(small_cat_used in g and len(g) > 1
+                   for g in info.groups)
+    assert in_multi, "small categorical did not join a bundle"
+    assert len(plain._models) == len(bundled._models)
+    for ta, tb in zip(plain._models, bundled._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        assert sorted(ta.split_feature[:nn]) ==             sorted(tb.split_feature[:nn])
+        np.testing.assert_allclose(
+            np.sort(ta.leaf_value[:ta.num_leaves]),
+            np.sort(tb.leaf_value[:tb.num_leaves]),
+            rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(plain.predict(X[:400]),
+                               bundled.predict(X[:400]),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_categorical_member_split_categories_correct():
+    """A bundled cat member's splits must route ORIGINAL category
+    values (not remapped bundle positions): with a label that depends
+    only on the two bundled cats' categories, the bundled model must
+    isolate them perfectly and agree with the unbundled model."""
+    rs = np.random.RandomState(3)
+    n = 4000
+    u = rs.rand(n)
+    cat_a = np.full(n, 7.0)
+    ta = u < 0.2
+    cat_a[ta] = rs.choice([1, 2, 3], size=int(ta.sum()))
+    cat_b = np.zeros(n)
+    tb = (u >= 0.5) & (u < 0.7)
+    cat_b[tb] = rs.choice([4, 5], size=int(tb.sum()))
+    noise = rs.randn(n, 2)
+    X = np.column_stack([cat_a, cat_b, noise])
+    y = ((cat_a == 2) | (cat_b == 5)).astype(float)
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "min_data_in_leaf": 5, "categorical_feature": [0, 1]}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=20)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=20)
+    info = bundled._engine.bundle
+    assert info is not None and any(len(g) > 1 for g in info.groups)
+    pb = bundled.predict(X)
+    assert np.mean((pb > 0.5) == (y > 0.5)) > 0.99
+    np.testing.assert_allclose(pb, plain.predict(X),
+                               rtol=2e-3, atol=2e-3)
