@@ -494,8 +494,16 @@ class Config:
     # histogram choice, docs/GPU-Performance.rst:134-158) | high (3-pass)
     # | highest (6-pass f32 emulation)
     hist_precision: str = "default"
-    # tree grower: compact (rows grouped by leaf; per-split work ~ leaf
-    # size) | masked (full-row masked histogram passes)
+    # tree grower: compact (the flagship: rows grouped by leaf,
+    # per-split work ~ leaf size) | masked (full-row masked histogram
+    # passes). "masked" is a deliberately simple CORRECTNESS ORACLE
+    # kept for differential testing (tests/test_grower_equivalence.py),
+    # not a performance choice: every split pays O(n) histogram work,
+    # and it lacks EFB / CEGB / interaction / forced splits /
+    # path-smooth / bynode / quantized — configs needing those either
+    # auto-upgrade to compact (quantized, forced, bynode, path-smooth;
+    # see GBDTBooster.__init__) or raise NotImplementedError
+    # (grow_tree_impl), and >50M row*leaf products raise outright
     grower: str = "compact"
     # rows per streaming chunk in the compact grower's partition pass
     # (perf knob; power of two. Larger chunks amortize per-chunk fixed

@@ -237,17 +237,20 @@ class GBDTBooster:
         dp_mode = {"feature": "feature",
                    "voting": "voting"}.get(cfg.tree_learner, "data")
         # bundling is a dataset property that sits below the parallel
-        # layer (feature_group.h:26): in data-parallel mode bundle
-        # columns shard by rows and their histograms psum like any
-        # other column. feature/voting modes still assume per-device
-        # column ownership the bundled search doesn't honor yet.
-        plain = (self.monotone is None
-                 and self.interaction_groups is None
-                 and self.forced is None and not self.cegb_enabled
-                 and cfg.feature_fraction_bynode >= 1.0
-                 and cfg.path_smooth <= 0.0 and not cfg.linear_tree
-                 and grower == "compact"
-                 and (not dp_active or dp_mode == "data"))
+        # layer (feature_group.h:26): data-parallel shards bundle
+        # columns by rows and psums their histograms; feature-parallel
+        # windows/owns bundle columns like plain columns; voting runs
+        # its ballot/election/exchange in bundle-column space.
+        # voting-parallel forces monotone_constraints_method=basic in
+        # the distributed setup below (reference config.cpp:443-446);
+        # the gate must see the EFFECTIVE method or a supported
+        # voting+intermediate config silently trains unbundled
+        mono_method = cfg.monotone_constraints_method
+        if dp_active and dp_mode == "voting":
+            mono_method = "basic"
+        plain = ((self.monotone is None or mono_method == "basic")
+                 and not cfg.linear_tree
+                 and grower == "compact")
         if cfg.enable_bundle and plain:
             binfo = ds.bundles(cfg)
             if binfo is not None:

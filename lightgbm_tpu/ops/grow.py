@@ -606,6 +606,11 @@ def _grow_compact_impl(cfg: GrowConfig,
     L = cfg.num_leaves
     B = cfg.num_bins
     F = bins_T.shape[0]
+    # ORIGINAL feature count: equals F except in bundled mode, where
+    # bins_T holds bundle columns but SplitResult.feature, the
+    # per-node masks (bynode / interaction) and branch sets all live
+    # in original-feature space
+    F_orig = feature_mask.shape[0]
     n = bins_T.shape[1]
     dtype = grad.dtype
     p = cfg.split
@@ -663,13 +668,24 @@ def _grow_compact_impl(cfg: GrowConfig,
         # feature/voting stay gated: their searches assume per-device
         # COLUMN ownership / local ballots, which the bundled search
         # (global [G,B] hist + member remap) does not yet honor.
-        if (cfg.cegb or interaction_groups is not None
-                or forced is not None
-                or has_mono or use_bynode or smoothing
-                or fp or vp):
+        # interaction constraints, per-node column sampling, and CEGB
+        # compose freely with bundling: all three are [F_orig]-space
+        # inputs (masks, branch sets, per-feature penalties), and the
+        # bundled search consumes them per member
+        # (feature_mask[member_ix] / gain_penalty[member_ix]) — no
+        # bundle-space translation exists to get wrong. The rest stay
+        # still gated: intermediate/advanced monotone re-search
+        # per-[F, B] boxes in ORIGINAL bin space, which has no
+        # bundle-position mapping. Everything else composes: all three
+        # parallel modes, interaction/bynode/CEGB ([F_orig]-space
+        # inputs consumed per member), basic monotone + path smoothing
+        # (scalar bounds/outputs mirror the plain eval_dir), forced
+        # splits (member-range reconstruction in forced_result).
+        if intermediate:
             raise NotImplementedError(
-                "EFB bundling supports plain and data-parallel training "
-                "only (gbdt.py gates the other combinations)")
+                "EFB bundling composes with everything except "
+                "intermediate/advanced monotone constraints "
+                "(gbdt.py gates the combination)")
         (bundle_of, offset_of, bundle_is_direct, member_at, tloc_at,
          end_at, bundle_nanpos, bundle_nan_at) = bundle_arrays
 
@@ -701,13 +717,47 @@ def _grow_compact_impl(cfg: GrowConfig,
                  parent_output=None, depth=None, bounds=None):
         fmask = feature_mask if extra_mask is None \
             else feature_mask & extra_mask
-        if bundled:
-            return find_best_split_bundled(hist, sg, sh, sc, member_at,
-                                           tloc_at, end_at,
-                                           bundle_is_direct,
-                                           bundle_nanpos, bundle_nan_at,
-                                           fmask, p, feat_is_cat,
-                                           feat_num_bins)
+        if bundled and not vp:
+            b_member, b_tloc = member_at, tloc_at
+            b_end, b_nanpos, b_nan = end_at, bundle_nanpos, bundle_nan_at
+            col_mask = None
+            if fp:
+                # feature-parallel over BUNDLE columns: slice the
+                # [G, B] metadata to this device's word-aligned column
+                # window, rebase the flat (g*B + p) indices into
+                # window space, and mask candidates to OWNED columns.
+                # fmask / feat_is_cat / feat_num_bins / gain_penalty
+                # stay GLOBAL — the search indexes them by ORIGINAL
+                # member feature id, which needs no rebasing (so the
+                # winning SplitInfo's feature is already global too).
+                def gsl(v, fill):
+                    if Fp > F:
+                        pad = jnp.full((Fp - F, v.shape[1]), fill,
+                                       v.dtype)
+                        v = jnp.concatenate([v, pad])
+                    return lax.dynamic_slice(
+                        v, (f_start, 0), (Fl, v.shape[1]))
+
+                b_member = gsl(member_at, -1)
+                b_tloc = gsl(tloc_at, 0)
+                b_end = jnp.where(b_member >= 0,
+                                  gsl(end_at, 0) - f_start * B, 0)
+                np_s = gsl(bundle_nanpos, -1)
+                b_nanpos = jnp.where(np_s >= 0, np_s - f_start * B, -1)
+                b_nan = gsl(bundle_nan_at, False)
+                col_mask = _fp_owner(f_start + jnp.arange(Fl)) == dev_idx
+            r = find_best_split_bundled(hist, sg, sh, sc, b_member,
+                                        b_tloc, b_end,
+                                        bundle_is_direct,
+                                        b_nanpos, b_nan,
+                                        fmask, p, feat_is_cat,
+                                        feat_num_bins, gain_penalty,
+                                        col_mask,
+                                        monotone_constraints=
+                                        monotone_constraints,
+                                        parent_output=parent_output,
+                                        leaf_depth=depth, bounds=bounds)
+            return _fp_combine(r) if fp else r
         if fp:
             # disjoint feature ownership over word-aligned windows: the
             # device's histogram covers ONLY its own Fl columns (built
@@ -773,11 +823,27 @@ def _grow_compact_impl(cfg: GrowConfig,
             p_loc = p._replace(
                 min_data_in_leaf=p.min_data_in_leaf / ndev,
                 min_sum_hessian_in_leaf=p.min_sum_hessian_in_leaf / ndev)
-            _, fgains = find_best_split(
-                hist, sg_loc, sh_loc, sc_loc, feat_num_bins,
-                feat_nan_bin, fmask, p_loc,
-                monotone_constraints, feat_is_cat, gain_penalty,
-                parent_output, depth, bounds, return_feature_gains=True)
+            if bundled:
+                # ballots/election/exchange run in bundle-COLUMN space
+                # (F here is the bundle-column count); the bundled
+                # search supplies per-column gains and the final
+                # search masks to elected columns
+                _, fgains = find_best_split_bundled(
+                    hist, sg_loc, sh_loc, sc_loc, member_at, tloc_at,
+                    end_at, bundle_is_direct, bundle_nanpos,
+                    bundle_nan_at, fmask, p_loc, feat_is_cat,
+                    feat_num_bins, gain_penalty,
+                    return_col_gains=True,
+                    monotone_constraints=monotone_constraints,
+                    parent_output=parent_output,
+                    leaf_depth=depth, bounds=bounds)
+            else:
+                _, fgains = find_best_split(
+                    hist, sg_loc, sh_loc, sc_loc, feat_num_bins,
+                    feat_nan_bin, fmask, p_loc,
+                    monotone_constraints, feat_is_cat, gain_penalty,
+                    parent_output, depth, bounds,
+                    return_feature_gains=True)
             k = min(cfg.voting_top_k, F)
             kth = jnp.sort(fgains)[F - k]
             ballot = jnp.isfinite(fgains) & (fgains >= kth)
@@ -797,6 +863,15 @@ def _grow_compact_impl(cfg: GrowConfig,
             gsel = lax.psum(sel, ax)
             ghist = jnp.sum(jnp.where(E[:, :, None, None], gsel[:, None],
                                       0), axis=0)         # [F, B, C]
+            if bundled:
+                return find_best_split_bundled(
+                    ghist, sg, sh, sc, member_at, tloc_at, end_at,
+                    bundle_is_direct, bundle_nanpos, bundle_nan_at,
+                    fmask, p, feat_is_cat, feat_num_bins,
+                    gain_penalty, col_mask=elected,
+                    monotone_constraints=monotone_constraints,
+                    parent_output=parent_output,
+                    leaf_depth=depth, bounds=bounds)
             return find_best_split(ghist, sg, sh, sc, feat_num_bins,
                                    feat_nan_bin, fmask & elected, p,
                                    monotone_constraints, feat_is_cat,
@@ -814,7 +889,8 @@ def _grow_compact_impl(cfg: GrowConfig,
         max(1, round(bynode * |usable|)). The reference samples with its
         sequential Random stream; this keyed-fold stream is an equally
         deterministic redesign."""
-        u = jax.random.uniform(jax.random.fold_in(node_key, idx), (F,))
+        u = jax.random.uniform(jax.random.fold_in(node_key, idx),
+                               (F_orig,))
         u = jnp.where(feature_mask, u, jnp.inf)
         rank = jnp.argsort(jnp.argsort(u))
         total = jnp.sum(feature_mask.astype(jnp.int32))
@@ -928,8 +1004,9 @@ def _grow_compact_impl(cfg: GrowConfig,
         def cegb_penalty(cnt, coupled_used, lazy_nu_leaf):
             """DeltaGain (cost_effective_gradient_boosting.hpp:81-97):
             tradeoff * (penalty_split*n + coupled-first-use + lazy)."""
-            pen = jnp.full((F,), cfg.cegb_tradeoff * cfg.cegb_split
-                           * 1.0, dtype) * cnt.astype(dtype)
+            pen = jnp.full((F_orig,), cfg.cegb_tradeoff
+                           * cfg.cegb_split * 1.0, dtype) \
+                * cnt.astype(dtype)
             pen = pen + jnp.where(coupled_used, 0.0,
                                   cfg.cegb_tradeoff * pen_coupled)
             if cegb_lazy:
@@ -1358,7 +1435,7 @@ def _grow_compact_impl(cfg: GrowConfig,
 
             return hist_body
 
-        carry_h = (acc0, jnp.zeros((F,), dtype))
+        carry_h = (acc0, jnp.zeros((F_orig,), dtype))
         if use_big:
             nh_big = lax.div(est_cnt, jnp.asarray(BK, jnp.int32))
             carry_h = lax.fori_loop(0, nh_big, make_hist_body(BK, zero),
@@ -1443,7 +1520,7 @@ def _grow_compact_impl(cfg: GrowConfig,
     )
     best = _BestSplits.init(L, B, dtype)
     root_mask = None if interaction_groups is None \
-        else allowed_features(jnp.zeros((F,), jnp.bool_))
+        else allowed_features(jnp.zeros((F_orig,), jnp.bool_))
     cegb_state = ()
     root_pen = None
     if cegb:
@@ -1454,8 +1531,8 @@ def _grow_compact_impl(cfg: GrowConfig,
                               axis=0).astype(dtype)               # [F]
         else:
             lazy_used = jnp.zeros((1, 1), jnp.bool_)
-            root_nu = jnp.zeros((F,), dtype)
-        lazy_nu = jnp.zeros((L, F), dtype).at[0].set(root_nu)
+            root_nu = jnp.zeros((F_orig,), dtype)
+        lazy_nu = jnp.zeros((L, F_orig), dtype).at[0].set(root_nu)
         cegb_state = (coupled_used, lazy_used, lazy_nu)
         root_pen = cegb_penalty(total_c, coupled_used, root_nu)
     mono_state = ()
@@ -1481,7 +1558,7 @@ def _grow_compact_impl(cfg: GrowConfig,
     root_node_mask = None
     if use_bynode:
         root_node_mask = node_feature_mask(0)
-        nmask_state = (jnp.zeros((L, F), jnp.bool_)
+        nmask_state = (jnp.zeros((L, F_orig), jnp.bool_)
                        .at[0].set(root_node_mask),)
         root_mask = root_node_mask if root_mask is None \
             else root_mask & root_node_mask
@@ -1520,7 +1597,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         leaf_buf=jnp.zeros((L,), jnp.int32),
         leaf_begin=jnp.zeros((L,), jnp.int32),
         leaf_count=jnp.zeros((L,), jnp.int32).at[0].set(n),
-        branch=jnp.zeros((L, F), jnp.bool_),
+        branch=jnp.zeros((L, F_orig), jnp.bool_),
         num_splits=jnp.asarray(0, jnp.int32),
         cegb=cegb_state, mono=mono_state, node_masks=nmask_state,
         pool=pool_state)
@@ -1806,7 +1883,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         can_go_deeper = depth_ok(new_depth)
         child_mask = None
         if interaction_groups is not None:
-            nb = branch[leaf] | (jnp.arange(F) == f_split)
+            nb = branch[leaf] | (jnp.arange(F_orig) == f_split)
             branch = branch.at[leaf].set(nb).at[R].set(nb)
             child_mask = allowed_features(nb)
         mask_l = mask_r = child_mask
@@ -1820,7 +1897,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         if cegb:
             coupled_used, _, lazy_nu = cegb_st
             first_use = ~coupled_used[f_split] & (pen_coupled[f_split] > 0)
-            coupled_used = coupled_used | (jnp.arange(F) == f_split)
+            coupled_used = coupled_used | (jnp.arange(F_orig) == f_split)
             # parent rows acquired f_split during the partition pass
             # (before the hist/nu pass read lazy_used), so est_nu[f]
             # is post-acquisition garbage; zero it, and zero the
@@ -1934,25 +2011,41 @@ def _grow_compact_impl(cfg: GrowConfig,
         like the regular search (feature_histogram.hpp:528)."""
         totals = jnp.sum(hist[0], axis=0)          # every row hits feat 0
         tg, th = totals[0], totals[1]
+        # the histogram COLUMN the forced feature lives in: its own
+        # column when plain, its bundle column under EFB
+        fcol = bundle_of[f] if bundled else f
         if fp:
-            # the forced feature's histogram lives on its owner device
+            # the forced column's histogram lives on its owner device
             # only; route it to everyone with one [B, 2] psum
-            own = _fp_owner(f) == dev_idx
-            lf = jnp.clip(f - f_start, 0, Fl - 1)
+            own = _fp_owner(fcol) == dev_idx
+            lf = jnp.clip(fcol - f_start, 0, Fl - 1)
             h_loc = lax.dynamic_index_in_dim(hist, lf, keepdims=False)
             h = lax.psum(jnp.where(own, h_loc, 0.0), cfg.axis_name)
         elif vp:
             # voting keeps per-device caches local; a forced (feature,
             # bin) needs the GLOBAL row — one [B, 2] psum
-            h = lax.psum(hist[f], cfg.axis_name)
+            h = lax.psum(hist[fcol], cfg.axis_name)
             tg = lax.psum(tg, cfg.axis_name)
             th = lax.psum(th, cfg.axis_name)
         else:
-            h = hist[f]                            # [B, 2]
+            h = hist[fcol]                         # [B, 2]
         binsb = jnp.arange(B)
         nanb = feat_nan_bin[f]
         sel = (binsb <= t) & ~((binsb == nanb) & (nanb >= 0))
         left = jnp.sum(h * sel[:, None].astype(h.dtype), axis=0)
+        if bundled:
+            # multi-member reconstruction (FixHistogram algebra): the
+            # member's right side for threshold t is its positions
+            # [off+t, off+nb-2] — the NaN position (off+nanb-1) sits
+            # inside and routes right, like the plain sel excluding
+            # the NaN bin from the left
+            off = offset_of[f]
+            nb = feat_num_bins[f]
+            rsel = (binsb >= off + t) & (binsb <= off + nb - 2)
+            right_m = jnp.sum(h * rsel[:, None].astype(h.dtype),
+                              axis=0)
+            left_m = jnp.stack([tg, th]) - right_m
+            left = jnp.where(bundle_is_direct[f], left, left_m)
         lg, lh = left[0], left[1]
         lc = jnp.round(lh * tc / jnp.maximum(th, 1e-15))
         rg, rh, rc = tg - lg, th - lh, tc - lc

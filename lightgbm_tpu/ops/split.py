@@ -123,6 +123,42 @@ def split_bounds_lrc(bounds):
     return bounds, bounds, bounds
 
 
+def _parent_gain_shifted(total, p: SplitParams, p_out):
+    """Parent gain at its (path-smoothed) output + min_gain_to_split —
+    the per-candidate shift both searches subtract before the argmax
+    (ComputeBestSplitForFeature's gain_shift)."""
+    if p.path_smooth > 0.0:
+        w_parent = smooth_output(leaf_output(total[0], total[1], p),
+                                 total[2], p_out, p)
+        parent_gain = gain_at_output(total[0], total[1], w_parent, p)
+    else:
+        parent_gain = leaf_gain(total[0], total[1], p)
+    return parent_gain + p.min_gain_to_split
+
+
+def _winner_outputs(lgs, lhs, lcs, rgs, rhs, rcs, is_sorted_cat,
+                    exact, p: SplitParams, p_out, b_lw, b_rw):
+    """The winning split's child outputs: sorted-categorical winners
+    use l2 + cat_l2 (feature_histogram.cpp:144); the exact path
+    smooths and clamps (CalculateSplittedLeafOutput composition)."""
+    p_cat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
+    if exact:
+        lo = jnp.where(
+            is_sorted_cat,
+            constrained_output(lgs, lhs, lcs, p_out, b_lw, p_cat),
+            constrained_output(lgs, lhs, lcs, p_out, b_lw, p))
+        ro = jnp.where(
+            is_sorted_cat,
+            constrained_output(rgs, rhs, rcs, p_out, b_rw, p_cat),
+            constrained_output(rgs, rhs, rcs, p_out, b_rw, p))
+    else:
+        lo = jnp.where(is_sorted_cat, leaf_output(lgs, lhs, p_cat),
+                       leaf_output(lgs, lhs, p))
+        ro = jnp.where(is_sorted_cat, leaf_output(rgs, rhs, p_cat),
+                       leaf_output(rgs, rhs, p))
+    return lo, ro
+
+
 def constrained_output(sum_g, sum_h, cnt, parent_output, bounds,
                        p: SplitParams):
     """Optimal output, then smoothing, then monotone min/max clamp — the
@@ -378,13 +414,7 @@ def find_best_split(hist: jnp.ndarray,
     # monotone depth penalty (ComputeBestSplitForFeature,
     # serial_tree_learner.cpp:988-997) — the scaling changes the
     # cross-feature ranking, so it must precede the argmax.
-    if p.path_smooth > 0.0:
-        w_parent = smooth_output(leaf_output(total[0], total[1], p),
-                                 total[2], p_out, p)
-        parent_gain = gain_at_output(total[0], total[1], w_parent, p)
-    else:
-        parent_gain = leaf_gain(total[0], total[1], p)
-    shift = parent_gain + p.min_gain_to_split
+    shift = _parent_gain_shifted(total, p, p_out)
     if gain_penalty is not None:
         nets = [g - shift - gain_penalty[:, None] for g in stacks]
     else:
@@ -436,32 +466,17 @@ def find_best_split(hist: jnp.ndarray,
     gain = jnp.where(jnp.isfinite(best_gain_net), best_gain_net,
                      K_MIN_SCORE)
 
-    # sorted categorical splits use l2 + cat_l2 for leaf outputs
-    # (feature_histogram.cpp:144 `l2 += cat_l2` before the output calc)
-    p_cat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
-    if exact:
-        # the winner's bounds: scalar pair as-is, or — for the advanced
-        # per-(feature, threshold) arrays — the values at (f, t) for
-        # the numeric winner / the scalar fallbacks for a cat winner
-        b_lw = b_rw = bounds
-        if bounds is not None and len(bounds) == 6:
-            b_lw = (jnp.where(is_cat, bounds[4], bounds[0][f, t]),
-                    jnp.where(is_cat, bounds[5], bounds[1][f, t]))
-            b_rw = (jnp.where(is_cat, bounds[4], bounds[2][f, t]),
-                    jnp.where(is_cat, bounds[5], bounds[3][f, t]))
-        lo = jnp.where(
-            is_sorted_cat,
-            constrained_output(lg, lh, lc, p_out, b_lw, p_cat),
-            constrained_output(lg, lh, lc, p_out, b_lw, p))
-        ro = jnp.where(
-            is_sorted_cat,
-            constrained_output(rg, rh, rc, p_out, b_rw, p_cat),
-            constrained_output(rg, rh, rc, p_out, b_rw, p))
-    else:
-        lo = jnp.where(is_sorted_cat, leaf_output(lg, lh, p_cat),
-                       leaf_output(lg, lh, p))
-        ro = jnp.where(is_sorted_cat, leaf_output(rg, rh, p_cat),
-                       leaf_output(rg, rh, p))
+    # the winner's bounds: scalar pair as-is, or — for the advanced
+    # per-(feature, threshold) arrays — the values at (f, t) for
+    # the numeric winner / the scalar fallbacks for a cat winner
+    b_lw = b_rw = bounds
+    if bounds is not None and len(bounds) == 6:
+        b_lw = (jnp.where(is_cat, bounds[4], bounds[0][f, t]),
+                jnp.where(is_cat, bounds[5], bounds[1][f, t]))
+        b_rw = (jnp.where(is_cat, bounds[4], bounds[2][f, t]),
+                jnp.where(is_cat, bounds[5], bounds[3][f, t]))
+    lo, ro = _winner_outputs(lg, lh, lc, rg, rh, rc, is_sorted_cat,
+                             exact, p, p_out, b_lw, b_rw)
 
     result = SplitResult(
         gain=gain.astype(dtype),
@@ -495,8 +510,14 @@ def find_best_split_bundled(hist: jnp.ndarray,
                             feature_mask: jnp.ndarray,
                             p: SplitParams,
                             feat_is_cat: jnp.ndarray | None = None,
-                            feat_num_bins: jnp.ndarray | None = None) \
-        -> SplitResult:
+                            feat_num_bins: jnp.ndarray | None = None,
+                            gain_penalty: jnp.ndarray | None = None,
+                            col_mask: jnp.ndarray | None = None,
+                            return_col_gains: bool = False,
+                            monotone_constraints: jnp.ndarray | None = None,
+                            parent_output: jnp.ndarray | None = None,
+                            leaf_depth: jnp.ndarray | None = None,
+                            bounds: tuple | None = None):
     """Best split over an EFB-bundled histogram (ops/bundling.py layout).
 
     Every candidate is one (bundle, position) cell:
@@ -550,6 +571,34 @@ def find_best_split_bundled(hist: jnp.ndarray,
         is_cat_pos = feat_is_cat[member_ix] & has_member   # [G, B]
     else:
         is_cat_pos = jnp.zeros((G, B), jnp.bool_)
+    if col_mask is not None:
+        # feature-parallel: only this device's OWNED bundle columns
+        # may propose candidates (window overlap on tail devices is
+        # resolved by ownership, exactly like the plain fp search)
+        has_member = has_member & col_mask[:, None]
+
+    # monotone-basic / path-smoothing support mirrors the plain
+    # search's eval_dir: gains via (smoothed, clamped) outputs when
+    # exact, directional validity per member's constraint sign —
+    # NEVER applied to categorical candidates (plain cat gains bypass
+    # direction checks too). Only scalar 2-tuple bounds reach here
+    # (basic/intermediate modes; the grower gates advanced x bundled).
+    exact = p.path_smooth > 0.0 or bounds is not None
+    p_out = jnp.asarray(0.0, dtype) if parent_output is None \
+        else parent_output
+    bounds_l, bounds_r, bounds_c = split_bounds_lrc(bounds)
+    if monotone_constraints is not None:
+        # direction validity never applies to categorical candidates
+        # (the plain cat families bypass it too)...
+        mc_pos = jnp.where(is_cat_pos, 0,
+                           monotone_constraints[member_ix])  # [G, B]
+        # ...but the depth PENALTY rescales every candidate of a
+        # constrained feature, cat or not (the plain search scales all
+        # five stacks via is_mono per feature)
+        mono_pos = (monotone_constraints[member_ix] != 0) & has_member
+    else:
+        mc_pos = None
+        mono_pos = None
 
     def eval_left(left, extra_valid):
         right = total[None, None, :] - left
@@ -562,7 +611,20 @@ def find_best_split_bundled(hist: jnp.ndarray,
             & (rh >= p.min_sum_hessian_in_leaf)
             & (lc > 0) & (rc > 0)
         )
-        gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p)
+        if exact:
+            lo_ = constrained_output(lg, lh, lc, p_out, bounds_l, p)
+            ro_ = constrained_output(rg, rh, rc, p_out, bounds_r, p)
+            gain = gain_at_output(lg, lh, lo_, p) \
+                + gain_at_output(rg, rh, ro_, p)
+        else:
+            lo_ = ro_ = None
+            gain = leaf_gain(lg, lh, p) + leaf_gain(rg, rh, p)
+        if mc_pos is not None:
+            if lo_ is None:
+                lo_ = leaf_output(lg, lh, p)
+                ro_ = leaf_output(rg, rh, p)
+            valid = valid & ~((mc_pos > 0) & (lo_ > ro_)) \
+                & ~((mc_pos < 0) & (lo_ < ro_))
         return jnp.where(valid, gain, K_MIN_SCORE)
 
     # direction 1: missing goes right. For multi members the member's
@@ -579,8 +641,12 @@ def find_best_split_bundled(hist: jnp.ndarray,
                       total[None, None, :] - (e - cum))
     g2 = eval_left(left2, has_nan & ~is_cat_pos)
 
-    parent_gain = leaf_gain(total[0], total[1], p)
-    shift = parent_gain + p.min_gain_to_split
+    shift = _parent_gain_shifted(total, p, p_out)
+    if gain_penalty is not None:
+        # CEGB DeltaGain per ORIGINAL feature, looked up through the
+        # position->member map (cost_effective_gradient_boosting.hpp)
+        shift = shift + jnp.where(has_member,
+                                  gain_penalty[member_ix], 0.0)
     stacks = [g1 - shift, g2 - shift]
 
     if feat_is_cat is not None:
@@ -603,19 +669,28 @@ def find_best_split_bundled(hist: jnp.ndarray,
         direct_member = member_ix[:, 0]
         col_cat = is_direct_f[direct_member] \
             & feat_is_cat[direct_member] & (member_at[:, 0] >= 0)
+        if col_mask is not None:
+            col_cat = col_cat & col_mask
         col_nb = jnp.where(
             col_cat,
             feat_num_bins[direct_member] if feat_num_bins is not None
             else 0, 0)
         _, g_fwd, g_bwd, csum_f, csum_b, (inv, used, participate) = \
             _cat_split_eval(h3, total[0], total[1], total[2],
-                            col_nb, p)
+                            col_nb, p, p_out, bounds_c)
         cmask2 = (col_cat & feature_mask[direct_member])[:, None]
         g_fwd = jnp.where(cmask2, g_fwd, K_MIN_SCORE)
         g_bwd = jnp.where(cmask2, g_bwd, K_MIN_SCORE)
         stacks += [g_oh - shift, g_fwd - shift, g_bwd - shift]
 
     net = jnp.stack(stacks)                       # [D, G, B]
+    if mono_pos is not None and p.monotone_penalty > 0.0:
+        # the penalty rescales constrained features' NET gains before
+        # the argmax (ComputeBestSplitForFeature ordering)
+        depth_ = jnp.asarray(0, jnp.int32) if leaf_depth is None \
+            else leaf_depth
+        mult = monotone_penalty_mult(depth_, p).astype(dtype)
+        net = jnp.where(mono_pos[None], net * mult, net)
     net = jnp.where(jnp.isfinite(net), net, K_MIN_SCORE)
 
     flat = jnp.argmax(net)
@@ -644,14 +719,10 @@ def find_best_split_bundled(hist: jnp.ndarray,
         cat_mask = jnp.zeros((B,), jnp.bool_)
     lgs, lhs, lcs = sel[0], sel[1], sel[2]
     rgs, rhs, rcs = total[0] - lgs, total[1] - lhs, total[2] - lcs
-    # sorted categorical outputs use l2 + cat_l2
-    # (feature_histogram.cpp:144)
-    p_cat = p._replace(lambda_l2=p.lambda_l2 + p.cat_l2)
-    lo = jnp.where(is_sorted_cat, leaf_output(lgs, lhs, p_cat),
-                   leaf_output(lgs, lhs, p))
-    ro = jnp.where(is_sorted_cat, leaf_output(rgs, rhs, p_cat),
-                   leaf_output(rgs, rhs, p))
-    return SplitResult(
+    lo, ro = _winner_outputs(lgs, lhs, lcs, rgs, rhs, rcs,
+                             is_sorted_cat, exact, p, p_out,
+                             bounds_l, bounds_r)
+    result = SplitResult(
         gain=jnp.where(jnp.isfinite(best), best, K_MIN_SCORE)
         .astype(dtype),
         feature=member_at[g, pos].astype(jnp.int32),
@@ -663,3 +734,8 @@ def find_best_split_bundled(hist: jnp.ndarray,
         right_sum_g=rgs, right_sum_h=rhs, right_count=rcs,
         left_output=lo,
         right_output=ro)
+    if return_col_gains:
+        # best net gain per bundle COLUMN — the voting-parallel local
+        # ballot in bundle space (VotingParallelTreeLearner top-k)
+        return result, jnp.max(net, axis=(0, 2))
+    return result

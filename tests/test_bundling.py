@@ -368,3 +368,187 @@ def test_categorical_member_split_categories_correct():
     assert np.mean((pb > 0.5) == (y > 0.5)) > 0.99
     np.testing.assert_allclose(pb, plain.predict(X),
                                rtol=2e-3, atol=2e-3)
+
+
+def test_bundled_interaction_constraints_match_unbundled():
+    """interaction_constraints x EFB (round 5): the constraint masks
+    and branch sets live in ORIGINAL feature space regardless of
+    bundling, so constrained training must produce the same trees
+    bundled and unbundled — and must never split across groups."""
+    X, y = _sparse_onehot(3000, groups=4, per_group=6, seed=21)
+    F = X.shape[1]
+    g1 = list(range(0, 12))           # blocks 0-1
+    g2 = list(range(12, F))           # blocks 2-3 + dense
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5,
+              "interaction_constraints": [g1, g2]}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=6)
+    assert bundled._engine.bundle is not None, "bundling did not engage"
+    for ta, tb in zip(plain._models, bundled._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        np.testing.assert_array_equal(ta.split_feature[:nn],
+                                      tb.split_feature[:nn])
+        np.testing.assert_array_equal(ta.threshold_bin[:nn],
+                                      tb.threshold_bin[:nn])
+    # constraint actually honored: no root-to-leaf path mixes groups
+    for t in bundled._models:
+        nn = t.num_nodes
+        used = set(int(f) for f in t.split_feature[:nn])
+        # per-tree check is necessary but loose; walk each path
+        def walk(node, seen):
+            if node < 0:
+                return
+            f = int(t.split_feature[node])
+            seen = seen | {f}
+            assert all(x < 12 for x in seen) or \
+                all(x >= 12 for x in seen), seen
+            walk(int(t.left_child[node]), seen)
+            walk(int(t.right_child[node]), seen)
+        if nn:
+            walk(0, set())
+
+
+def test_bundled_bynode_sampling_matches_unbundled():
+    """feature_fraction_bynode x EFB (round 5): the per-node keyed
+    draw samples ORIGINAL features (F_orig, not bundle columns), so
+    the sampled masks — and therefore the trees — are identical
+    bundled and unbundled."""
+    X, y = _sparse_onehot(3000, groups=4, per_group=6, seed=22)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "feature_fraction_bynode": 0.6,
+              "feature_fraction_seed": 7}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=6)
+    assert bundled._engine.bundle is not None, "bundling did not engage"
+    for ta, tb in zip(plain._models, bundled._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        np.testing.assert_array_equal(ta.split_feature[:nn],
+                                      tb.split_feature[:nn])
+        np.testing.assert_array_equal(ta.threshold_bin[:nn],
+                                      tb.threshold_bin[:nn])
+        np.testing.assert_allclose(ta.leaf_value[:ta.num_leaves],
+                                   tb.leaf_value[:tb.num_leaves],
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_bundled_cegb_matches_unbundled():
+    """CEGB x EFB (round 5): the per-feature penalties (split /
+    coupled first-use / lazy per-row acquisition) are [F_orig]-space
+    quantities consumed through the position->member map
+    (gain_penalty[member_ix]), so CEGB-regularized training must
+    produce the same trees bundled and unbundled."""
+    X, y = _sparse_onehot(3000, groups=4, per_group=6, seed=25)
+    F = X.shape[1]
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5,
+              "cegb_penalty_split": 1e-4,
+              "cegb_penalty_feature_coupled": [0.5] * F,
+              "cegb_penalty_feature_lazy": [1e-3] * F,
+              "cegb_tradeoff": 1.0}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=6)
+    assert bundled._engine.bundle is not None, "bundling did not engage"
+    assert bundled._engine.cegb_enabled
+    for ta, tb in zip(plain._models, bundled._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        np.testing.assert_array_equal(ta.split_feature[:nn],
+                                      tb.split_feature[:nn])
+        np.testing.assert_array_equal(ta.threshold_bin[:nn],
+                                      tb.threshold_bin[:nn])
+        np.testing.assert_allclose(ta.leaf_value[:ta.num_leaves],
+                                   tb.leaf_value[:tb.num_leaves],
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_bundled_basic_monotone_matches_unbundled():
+    """basic monotone x EFB (round 5): directional validity and the
+    scalar output bounds apply per MEMBER through the position map,
+    so constrained training must match the unbundled model."""
+    X, y = _sparse_onehot(3000, groups=4, per_group=6, seed=27)
+    F = X.shape[1]
+    mono = [0] * F
+    mono[0], mono[7], mono[F - 2] = 1, -1, 1   # two members + a dense
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "monotone_constraints": mono,
+              "monotone_constraints_method": "basic"}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=6)
+    assert bundled._engine.bundle is not None, "bundling did not engage"
+    for ta, tb in zip(plain._models, bundled._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        np.testing.assert_array_equal(ta.split_feature[:nn],
+                                      tb.split_feature[:nn])
+        np.testing.assert_array_equal(ta.threshold_bin[:nn],
+                                      tb.threshold_bin[:nn])
+        np.testing.assert_allclose(ta.leaf_value[:ta.num_leaves],
+                                   tb.leaf_value[:tb.num_leaves],
+                                   rtol=2e-4, atol=2e-4)
+    # the monotone property itself must hold on the bundled model
+    probe = np.zeros((50, F))
+    probe[:, 0] = np.linspace(0, 2, 50)
+    pred = bundled.predict(probe)
+    assert np.all(np.diff(pred) >= -1e-7)
+
+
+def test_bundled_path_smoothing_matches_unbundled():
+    """path_smooth x EFB (round 5): smoothed outputs/gains flow
+    through the bundled eval exactly like the plain eval_dir."""
+    X, y = _sparse_onehot(3000, groups=4, per_group=6, seed=28)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "path_smooth": 5.0}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=6)
+    assert bundled._engine.bundle is not None, "bundling did not engage"
+    for ta, tb in zip(plain._models, bundled._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        np.testing.assert_array_equal(ta.split_feature[:nn],
+                                      tb.split_feature[:nn])
+        np.testing.assert_allclose(ta.leaf_value[:ta.num_leaves],
+                                   tb.leaf_value[:tb.num_leaves],
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_bundled_forced_splits_match_unbundled(tmp_path):
+    """forcedsplits x EFB (round 5): a forced (feature, bin) split on
+    a bundled MEMBER reconstructs its left stats from the bundle
+    column (total - member range); trees must match unbundled."""
+    import json
+    X, y = _sparse_onehot(3000, groups=4, per_group=6, seed=29)
+    # force the root on member feature 0 at a threshold inside bin 0
+    # (zeros left, its one-hot value right); then free growth
+    path = tmp_path / "forced.json"
+    path.write_text(json.dumps({"feature": 0, "threshold": 0.0}))
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5,
+              "forcedsplits_filename": str(path)}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=5)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+    assert bundled._engine.bundle is not None, "bundling did not engage"
+    for ta, tb in zip(plain._models, bundled._models):
+        assert int(ta.split_feature[0]) == 0
+        assert int(tb.split_feature[0]) == 0
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        np.testing.assert_array_equal(ta.split_feature[:nn],
+                                      tb.split_feature[:nn])
+        np.testing.assert_allclose(ta.leaf_value[:ta.num_leaves],
+                                   tb.leaf_value[:tb.num_leaves],
+                                   rtol=2e-4, atol=2e-4)

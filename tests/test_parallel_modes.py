@@ -187,3 +187,89 @@ def test_voting_parallel_distribution_skew_still_learns():
     p = voting.predict(X)
     assert np.all(np.isfinite(p))
     assert np.mean((p > 0.5) == (y > 0.5)) > 0.9
+
+
+@needs_mesh
+def test_feature_parallel_bundled_matches_serial_bundled():
+    """EFB x feature-parallel (round 5): bundle columns window and
+    own per device exactly like plain columns — metadata slices
+    rebase into window space, candidates mask to owned columns, and
+    the winning SplitInfo (already carrying the ORIGINAL member
+    feature id) allreduces. Trees must equal single-device bundled
+    training exactly."""
+    rs = np.random.RandomState(31)
+    n, groups, per_group = 4000, 4, 6
+    cols, signal = [], np.zeros(n)
+    for g in range(groups):
+        pick = rs.randint(0, per_group, n)
+        block = np.zeros((n, per_group))
+        vals = rs.rand(per_group) * 2
+        block[np.arange(n), pick] = vals[pick]
+        cols.append(block)
+        signal += vals[pick]
+    dense = rs.randn(n, 2)
+    X = np.hstack(cols + [dense])
+    y = (signal + 0.5 * dense[:, 0]
+         + 0.3 * rs.randn(n) > np.median(signal)).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "enable_bundle": True}
+    serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=6)
+    feat = lgb.train(dict(params, tree_learner="feature"),
+                     lgb.Dataset(X, label=y), num_boost_round=6)
+    assert serial._engine.bundle is not None
+    assert feat._engine.bundle is not None, "fp EFB did not engage"
+    assert feat._engine.mesh is not None
+    assert _trees_equal(serial, feat)
+    np.testing.assert_allclose(serial.predict(X[:200]),
+                               feat.predict(X[:200]),
+                               rtol=1e-5, atol=1e-7)
+
+
+@needs_mesh
+def test_voting_parallel_bundled_full_vote_matches_data_bundled():
+    """EFB x voting-parallel (round 5): ballots, election and the
+    elected-columns exchange all run in bundle-COLUMN space. With
+    2*top_k >= #bundle-columns every column is elected, so voting
+    must equal bundled data-parallel exactly."""
+    rs = np.random.RandomState(33)
+    n, groups, per_group = 4096, 3, 5
+    cols, signal = [], np.zeros(n)
+    for g in range(groups):
+        pick = rs.randint(0, per_group, n)
+        block = np.zeros((n, per_group))
+        vals = rs.rand(per_group) * 2
+        block[np.arange(n), pick] = vals[pick]
+        cols.append(block)
+        signal += vals[pick]
+    dense = rs.randn(n, 2)
+    X = np.hstack(cols + [dense])
+    y = (signal + 0.5 * dense[:, 0]
+         + 0.3 * rs.randn(n) > np.median(signal)).astype(float)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "enable_bundle": True,
+              "top_k": 20}
+    data = lgb.train(dict(params, tree_learner="data"),
+                     lgb.Dataset(X, label=y), num_boost_round=6)
+    voting = lgb.train(dict(params, tree_learner="voting"),
+                       lgb.Dataset(X, label=y), num_boost_round=6)
+    assert data._engine.bundle is not None
+    assert voting._engine.bundle is not None, "vp EFB did not engage"
+    # identical structure; leaf values match to f32 summation-order
+    # noise (the elected-columns exchange sums hist = select+reduce
+    # in a different order than data-parallel's direct psum)
+    for ta, tb in zip(data._models, voting._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        np.testing.assert_array_equal(ta.split_feature[:nn],
+                                      tb.split_feature[:nn])
+        np.testing.assert_array_equal(ta.threshold_bin[:nn],
+                                      tb.threshold_bin[:nn])
+        np.testing.assert_allclose(ta.leaf_value[:ta.num_leaves],
+                                   tb.leaf_value[:tb.num_leaves],
+                                   rtol=1e-4, atol=1e-4)
+    # restricted vote must still learn (approximation regime)
+    tiny = lgb.train(dict(params, tree_learner="voting", top_k=2),
+                     lgb.Dataset(X, label=y), num_boost_round=8)
+    p = tiny.predict(X)
+    assert np.mean((p > 0.5) == (y > 0.5)) > 0.85
