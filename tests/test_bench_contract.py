@@ -1,0 +1,61 @@
+"""The driver contract of bench.py: ONE parseable JSON line on stdout
+and exit code 0, regardless of backend health (BENCH_r01/r03/r04 were
+lost to stack traces or timeouts before this was hardened)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def _run(env_extra, timeout):
+    # fixed minimal env: an ambient BENCH_* leak (e.g. BENCH_WORKER=1
+    # or a short BENCH_DEADLINE) would silently change which protocol
+    # path runs — same env-poisoning class the RSS test scrubs for
+    env = {"PATH": os.environ.get("PATH", "/usr/bin:/bin"),
+           "HOME": os.environ.get("HOME", "/root")}
+    env.update(env_extra)
+    return subprocess.run([sys.executable, BENCH], env=env,
+                          capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def test_bench_success_emits_one_json_line():
+    r = _run({"BENCH_PLATFORM": "cpu", "BENCH_ROWS": "4000",
+              "BENCH_VALID": "1000", "BENCH_ITERS": "2",
+              "BENCH_AUC_ITERS": "3", "BENCH_LEAVES": "7",
+              "BENCH_BINS": "15", "BENCH_DEADLINE": "700"},
+             timeout=900)
+    assert r.returncode == 0, r.stderr[-1500:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, rec
+    assert rec["value"] is not None and rec["value"] > 0
+    assert "error" not in rec
+
+
+def test_bench_failure_emits_one_json_line_within_deadline():
+    """A dead backend must still produce the one-line record, inside
+    BENCH_DEADLINE, with value null and the error recorded. Forced
+    deterministically by giving the probe a zero retry budget."""
+    t0 = time.time()
+    r = _run({"BENCH_PLATFORM": "cpu", "BENCH_ROWS": "4000",
+              "BENCH_PROBE_RETRIES": "0", "BENCH_DEADLINE": "120"},
+             timeout=300)
+    wall = time.time() - t0
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert wall < 120, f"exceeded BENCH_DEADLINE ({wall:.0f}s)"
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    assert rec["value"] is None
+    assert rec["vs_baseline"] is None
+    assert "error" in rec
+    assert "last_measured" in rec and \
+        rec["last_measured"]["value"] is not None

@@ -76,7 +76,7 @@ def test_two_round_sampled_mappers_close(tmp_path):
 
 
 _RSS_SCRIPT = r"""
-import os, sys
+import sys
 sys.path.insert(0, {repo!r})
 import lightgbm_tpu as lgb
 
