@@ -241,16 +241,7 @@ class GBDTBooster:
         # columns by rows and psums their histograms; feature-parallel
         # windows/owns bundle columns like plain columns; voting runs
         # its ballot/election/exchange in bundle-column space.
-        # voting-parallel forces monotone_constraints_method=basic in
-        # the distributed setup below (reference config.cpp:443-446);
-        # the gate must see the EFFECTIVE method or a supported
-        # voting+intermediate config silently trains unbundled
-        mono_method = cfg.monotone_constraints_method
-        if dp_active and dp_mode == "voting":
-            mono_method = "basic"
-        plain = ((self.monotone is None or mono_method == "basic")
-                 and not cfg.linear_tree
-                 and grower == "compact")
+        plain = (not cfg.linear_tree and grower == "compact")
         if cfg.enable_bundle and plain:
             binfo = ds.bundles(cfg)
             if binfo is not None:

@@ -660,32 +660,22 @@ def _grow_compact_impl(cfg: GrowConfig,
 
     bundled = cfg.bundled and bundle_arrays is not None
     if bundled:
-        # Bundling sits BELOW the parallel layer in data-parallel mode,
-        # exactly like FeatureGroup in the reference (feature_group.h:26
-        # is a dataset property every learner consumes): bundle columns
-        # are just columns — rows shard, bundle histograms psum, and the
-        # replicated bundled search is deterministic per device.
-        # feature/voting stay gated: their searches assume per-device
-        # COLUMN ownership / local ballots, which the bundled search
-        # (global [G,B] hist + member remap) does not yet honor.
-        # interaction constraints, per-node column sampling, and CEGB
-        # compose freely with bundling: all three are [F_orig]-space
-        # inputs (masks, branch sets, per-feature penalties), and the
-        # bundled search consumes them per member
-        # (feature_mask[member_ix] / gain_penalty[member_ix]) — no
-        # bundle-space translation exists to get wrong. The rest stay
-        # still gated: intermediate/advanced monotone re-search
-        # per-[F, B] boxes in ORIGINAL bin space, which has no
-        # bundle-position mapping. Everything else composes: all three
-        # parallel modes, interaction/bynode/CEGB ([F_orig]-space
-        # inputs consumed per member), basic monotone + path smoothing
-        # (scalar bounds/outputs mirror the plain eval_dir), forced
-        # splits (member-range reconstruction in forced_result).
-        if intermediate:
-            raise NotImplementedError(
-                "EFB bundling composes with everything except "
-                "intermediate/advanced monotone constraints "
-                "(gbdt.py gates the combination)")
+        # Bundling sits BELOW the learner layer exactly like the
+        # reference's FeatureGroup (feature_group.h:26 is a dataset
+        # property every learner consumes), and composes with the FULL
+        # feature matrix (round 5) — nothing is gated:
+        # - all three parallel modes: data (rows shard, bundle hists
+        #   psum), feature (bundle columns window/own per device),
+        #   voting (ballot/election/exchange in bundle-column space);
+        # - interaction/bynode/CEGB: [F_orig]-space inputs (masks,
+        #   branch sets, penalties) consumed per member
+        #   (feature_mask[member_ix] / gain_penalty[member_ix]);
+        # - every monotone method: basic/intermediate use scalar
+        #   per-leaf bounds; advanced's [F_orig, B] per-threshold
+        #   bound arrays gather into candidate space through the
+        #   position->member map;
+        # - path smoothing, forced splits (member-range reconstruction
+        #   in forced_result), categorical members.
         (bundle_of, offset_of, bundle_is_direct, member_at, tloc_at,
          end_at, bundle_nanpos, bundle_nan_at) = bundle_arrays
 
@@ -1547,8 +1537,8 @@ def _grow_compact_impl(cfg: GrowConfig,
         if advanced:
             # per-leaf bin-space boxes [lo, hi) per feature; the root
             # covers everything
-            box_lo0 = jnp.zeros((L, F), jnp.int32)
-            box_hi0 = jnp.full((L, F), B, jnp.int32)
+            box_lo0 = jnp.zeros((L, F_orig), jnp.int32)
+            box_hi0 = jnp.full((L, F_orig), B, jnp.int32)
             mono_state = mono_state + (box_lo0, box_hi0)
             root_bounds = advanced_bounds(box_lo0, box_hi0,
                                           tree.leaf_value,
@@ -1860,7 +1850,7 @@ def _grow_compact_impl(cfg: GrowConfig,
                 # compute each child's per-threshold bounds from the
                 # post-split leaf set
                 blo, bhi = mono_st[3], mono_st[4]
-                fsel = jnp.arange(F) == f_split
+                fsel = jnp.arange(F_orig) == f_split
                 cut_num = fsel & is_num
                 l_hi = jnp.where(cut_num,
                                  jnp.minimum(bhi[leaf], t_bin + 1),

@@ -577,16 +577,29 @@ def find_best_split_bundled(hist: jnp.ndarray,
         # resolved by ownership, exactly like the plain fp search)
         has_member = has_member & col_mask[:, None]
 
-    # monotone-basic / path-smoothing support mirrors the plain
-    # search's eval_dir: gains via (smoothed, clamped) outputs when
-    # exact, directional validity per member's constraint sign —
-    # NEVER applied to categorical candidates (plain cat gains bypass
-    # direction checks too). Only scalar 2-tuple bounds reach here
-    # (basic/intermediate modes; the grower gates advanced x bundled).
+    # monotone / path-smoothing support mirrors the plain search's
+    # eval_dir: gains via (smoothed, clamped) outputs when exact,
+    # directional validity per member's constraint sign — NEVER
+    # applied to categorical candidates (plain cat gains bypass
+    # direction checks too). Bounds are scalar pairs
+    # (basic/intermediate) or — advanced mode — per-(feature,
+    # threshold) [F_orig, B] arrays, gathered into candidate space
+    # through the position->member map.
     exact = p.path_smooth > 0.0 or bounds is not None
     p_out = jnp.asarray(0.0, dtype) if parent_output is None \
         else parent_output
     bounds_l, bounds_r, bounds_c = split_bounds_lrc(bounds)
+    adv = bounds is not None and len(bounds) == 6
+    if adv:
+        def _gpos(arr):
+            # [F_orig, Bf] -> per-candidate [G, B]: the member's bound
+            # at its local threshold bin (invalid cells are masked by
+            # has_member before they can win)
+            return arr[member_ix,
+                       jnp.clip(tloc_at, 0, arr.shape[1] - 1)]
+
+        bounds_l = (_gpos(bounds_l[0]), _gpos(bounds_l[1]))
+        bounds_r = (_gpos(bounds_r[0]), _gpos(bounds_r[1]))
     if monotone_constraints is not None:
         # direction validity never applies to categorical candidates
         # (the plain cat families bypass it too)...
@@ -600,7 +613,9 @@ def find_best_split_bundled(hist: jnp.ndarray,
         mc_pos = None
         mono_pos = None
 
-    def eval_left(left, extra_valid):
+    def eval_left(left, extra_valid, bl=None, br=None):
+        if bl is None:
+            bl, br = bounds_l, bounds_r
         right = total[None, None, :] - left
         lg, lh, lc = left[..., 0], left[..., 1], left[..., 2]
         rg, rh, rc = right[..., 0], right[..., 1], right[..., 2]
@@ -612,8 +627,8 @@ def find_best_split_bundled(hist: jnp.ndarray,
             & (lc > 0) & (rc > 0)
         )
         if exact:
-            lo_ = constrained_output(lg, lh, lc, p_out, bounds_l, p)
-            ro_ = constrained_output(rg, rh, rc, p_out, bounds_r, p)
+            lo_ = constrained_output(lg, lh, lc, p_out, bl, p)
+            ro_ = constrained_output(rg, rh, rc, p_out, br, p)
             gain = gain_at_output(lg, lh, lo_, p) \
                 + gain_at_output(rg, rh, ro_, p)
         else:
@@ -662,7 +677,10 @@ def find_best_split_bundled(hist: jnp.ndarray,
         left_oh = jnp.where(
             ((tloc_at == 0) & ~direct_pos)[:, :, None],
             total[None, None, :] - (e - cum), h3)
-        g_oh = eval_left(left_oh, is_cat_pos & use_oh)
+        # cat candidates take the CAT bounds (scalar fallbacks in
+        # advanced mode), like the plain _cat_split_eval path
+        g_oh = eval_left(left_oh, is_cat_pos & use_oh,
+                         bounds_c, bounds_c)
         # sorted-subset family for direct wide-cat columns: their rows
         # of the bundle histogram ARE the feature histograms, so the
         # plain machinery runs verbatim
@@ -719,9 +737,18 @@ def find_best_split_bundled(hist: jnp.ndarray,
         cat_mask = jnp.zeros((B,), jnp.bool_)
     lgs, lhs, lcs = sel[0], sel[1], sel[2]
     rgs, rhs, rcs = total[0] - lgs, total[1] - lhs, total[2] - lcs
+    if adv:
+        # the winner's bounds: the gathered value at (g, pos) for a
+        # numeric winner, the scalar cat fallbacks otherwise
+        b_lw = (jnp.where(is_cat_win, bounds[4], bounds_l[0][g, pos]),
+                jnp.where(is_cat_win, bounds[5], bounds_l[1][g, pos]))
+        b_rw = (jnp.where(is_cat_win, bounds[4], bounds_r[0][g, pos]),
+                jnp.where(is_cat_win, bounds[5], bounds_r[1][g, pos]))
+    else:
+        b_lw, b_rw = bounds_l, bounds_r
     lo, ro = _winner_outputs(lgs, lhs, lcs, rgs, rhs, rcs,
                              is_sorted_cat, exact, p, p_out,
-                             bounds_l, bounds_r)
+                             b_lw, b_rw)
     result = SplitResult(
         gain=jnp.where(jnp.isfinite(best), best, K_MIN_SCORE)
         .astype(dtype),

@@ -470,38 +470,6 @@ def test_bundled_cegb_matches_unbundled():
                                    rtol=2e-4, atol=2e-4)
 
 
-def test_bundled_basic_monotone_matches_unbundled():
-    """basic monotone x EFB (round 5): directional validity and the
-    scalar output bounds apply per MEMBER through the position map,
-    so constrained training must match the unbundled model."""
-    X, y = _sparse_onehot(3000, groups=4, per_group=6, seed=27)
-    F = X.shape[1]
-    mono = [0] * F
-    mono[0], mono[7], mono[F - 2] = 1, -1, 1   # two members + a dense
-    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
-              "min_data_in_leaf": 5, "monotone_constraints": mono,
-              "monotone_constraints_method": "basic"}
-    plain = lgb.train({**params, "enable_bundle": False},
-                      lgb.Dataset(X, label=y), num_boost_round=6)
-    bundled = lgb.train({**params, "enable_bundle": True},
-                        lgb.Dataset(X, label=y), num_boost_round=6)
-    assert bundled._engine.bundle is not None, "bundling did not engage"
-    for ta, tb in zip(plain._models, bundled._models):
-        assert ta.num_leaves == tb.num_leaves
-        nn = ta.num_nodes
-        np.testing.assert_array_equal(ta.split_feature[:nn],
-                                      tb.split_feature[:nn])
-        np.testing.assert_array_equal(ta.threshold_bin[:nn],
-                                      tb.threshold_bin[:nn])
-        np.testing.assert_allclose(ta.leaf_value[:ta.num_leaves],
-                                   tb.leaf_value[:tb.num_leaves],
-                                   rtol=2e-4, atol=2e-4)
-    # the monotone property itself must hold on the bundled model
-    probe = np.zeros((50, F))
-    probe[:, 0] = np.linspace(0, 2, 50)
-    pred = bundled.predict(probe)
-    assert np.all(np.diff(pred) >= -1e-7)
-
 
 def test_bundled_path_smoothing_matches_unbundled():
     """path_smooth x EFB (round 5): smoothed outputs/gains flow
@@ -552,3 +520,77 @@ def test_bundled_forced_splits_match_unbundled(tmp_path):
         np.testing.assert_allclose(ta.leaf_value[:ta.num_leaves],
                                    tb.leaf_value[:tb.num_leaves],
                                    rtol=2e-4, atol=2e-4)
+
+
+
+@pytest.mark.parametrize("method", ["basic", "intermediate", "advanced"])
+def test_bundled_monotone_matches_unbundled(method):
+    """monotone x EFB, all three methods (round 5): basic/intermediate
+    use scalar per-leaf bounds, advanced ('monotone precise') gathers
+    its [F_orig, B] per-threshold bound arrays into candidate space
+    via (member_at, tloc_at). Constrained training must match the
+    unbundled model tree-exactly, and the monotone property must hold
+    on the bundled model."""
+    X, y = _sparse_onehot(3000, groups=4, per_group=6, seed=27)
+    F = X.shape[1]
+    mono = [0] * F
+    mono[0], mono[7], mono[F - 2] = 1, -1, 1   # two members + a dense
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "monotone_constraints": mono,
+              "monotone_constraints_method": method}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=6)
+    assert bundled._engine.bundle is not None, "bundling did not engage"
+    for ta, tb in zip(plain._models, bundled._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        np.testing.assert_array_equal(ta.split_feature[:nn],
+                                      tb.split_feature[:nn])
+        np.testing.assert_array_equal(ta.threshold_bin[:nn],
+                                      tb.threshold_bin[:nn])
+        np.testing.assert_allclose(ta.leaf_value[:ta.num_leaves],
+                                   tb.leaf_value[:tb.num_leaves],
+                                   rtol=2e-4, atol=2e-4)
+    probe = np.zeros((50, F))
+    probe[:, 0] = np.linspace(0, 2, 50)
+    pred = bundled.predict(probe)
+    assert np.all(np.diff(pred) >= -1e-7)
+
+
+def test_bundled_advanced_monotone_with_cat_and_nan_members():
+    """advanced monotone x EFB with categorical AND NaN-carrying
+    bundle members present: exercises the cat candidates' scalar
+    bound fallbacks (bounds_c / the is_cat_win winner-bounds branch)
+    and the NaN members' tloc gather alongside the advanced bound
+    arrays. Cat near-tie rounding permutes expansion order, so the
+    contract is order-invariant (split multisets + predictions)."""
+    X, y, cat_idx = _mixed_cat_onehot(4000, seed=14)
+    rs = np.random.RandomState(6)
+    X = X.copy()
+    X[rs.rand(len(X)) < 0.08, 1] = np.nan     # NaN-carrying member
+    F = X.shape[1]
+    mono = [0] * F
+    mono[0], mono[3] = 1, -1                  # numeric members only
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "categorical_feature": cat_idx,
+              "monotone_constraints": mono,
+              "monotone_constraints_method": "advanced"}
+    plain = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(X, label=y), num_boost_round=6)
+    bundled = lgb.train({**params, "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=6)
+    assert bundled._engine.bundle is not None, "bundling did not engage"
+    for ta, tb in zip(plain._models, bundled._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        assert sorted(ta.split_feature[:nn]) == \
+            sorted(tb.split_feature[:nn])
+    np.testing.assert_allclose(plain.predict(X[:400]),
+                               bundled.predict(X[:400]),
+                               rtol=2e-3, atol=2e-3)
+    probe = np.zeros((50, F))
+    probe[:, 0] = np.linspace(0, 2, 50)
+    pred = bundled.predict(probe)
+    assert np.all(np.diff(pred) >= -1e-7)
