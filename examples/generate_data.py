@@ -1,0 +1,95 @@
+"""Generate the example datasets (label-first CSVs, reference layout).
+
+The reference ships binary/regression/lambdarank/multiclass example
+data files (examples/*/ *.train, *.test); this repo generates
+equivalent synthetic sets instead of copying them. Deterministic:
+seeded, so re-running reproduces byte-identical files.
+
+Usage:  python examples/generate_data.py [outdir]
+"""
+
+import os
+import sys
+
+import numpy as np
+
+
+def _write(path, y, X, fmt="%.6g"):
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt=fmt)
+
+
+def binary(d, n=7000, f=28, seed=1):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    logits = X[:, :8] @ rs.randn(8) + 0.4 * rs.randn(n)
+    y = (logits > 0).astype(float)
+    cut = int(n * 0.85)
+    _write(os.path.join(d, "binary.train"), y[:cut], X[:cut])
+    _write(os.path.join(d, "binary.test"), y[cut:], X[cut:])
+
+
+def regression(d, n=7000, f=20, seed=2):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    y = X[:, :5] @ rs.randn(5) + 0.3 * rs.randn(n)
+    cut = int(n * 0.85)
+    _write(os.path.join(d, "regression.train"), y[:cut], X[:cut])
+    _write(os.path.join(d, "regression.test"), y[cut:], X[cut:])
+
+
+def multiclass(d, n=6000, f=12, k=5, seed=3):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    centers = rs.randn(k, f) * 1.5
+    y = np.argmin(
+        ((X[:, None, :] - centers[None]) ** 2).sum(-1), axis=1
+    ).astype(float)
+    cut = int(n * 0.85)
+    _write(os.path.join(d, "multiclass.train"), y[:cut], X[:cut])
+    _write(os.path.join(d, "multiclass.test"), y[cut:], X[cut:])
+
+
+def lambdarank(d, n_query=300, per_q=15, f=10, seed=4):
+    rs = np.random.RandomState(seed)
+    n = n_query * per_q
+    X = rs.randn(n, f)
+    rel = X[:, 0] + 0.5 * X[:, 3] + 0.4 * rs.randn(n)
+    # graded relevance 0-4 per query by within-query rank
+    y = np.zeros(n)
+    for q in range(n_query):
+        s = slice(q * per_q, (q + 1) * per_q)
+        order = np.argsort(-rel[s])
+        grades = np.zeros(per_q)
+        grades[order[:2]] = [4, 3]
+        grades[order[2:5]] = 2
+        grades[order[5:8]] = 1
+        y[s] = grades
+    cut_q = int(n_query * 0.85)
+    cut = cut_q * per_q
+    _write(os.path.join(d, "rank.train"), y[:cut], X[:cut])
+    _write(os.path.join(d, "rank.test"), y[cut:], X[cut:])
+    np.savetxt(os.path.join(d, "rank.train.query"),
+               np.full(cut_q, per_q, np.int64), fmt="%d")
+    np.savetxt(os.path.join(d, "rank.test.query"),
+               np.full(n_query - cut_q, per_q, np.int64), fmt="%d")
+
+
+GENERATORS = {
+    "binary_classification": binary,
+    "regression": regression,
+    "multiclass_classification": multiclass,
+    "lambdarank": lambdarank,
+}
+
+
+def main(base=None):
+    base = base or os.path.dirname(os.path.abspath(__file__))
+    for name, gen in GENERATORS.items():
+        d = os.path.join(base, name)
+        os.makedirs(d, exist_ok=True)
+        gen(d)
+        print(f"generated {name}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

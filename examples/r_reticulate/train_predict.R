@@ -11,8 +11,14 @@
 
 library(reticulate)
 
-# point reticulate at the repo (or pip-install the package and skip)
-repo <- Sys.getenv("LIGHTGBM_TPU_PATH", unset = "/root/repo")
+# point reticulate at the repo (or pip-install the package and skip);
+# default = two directories above this script
+script_dir <- tryCatch(
+  dirname(normalizePath(sys.frame(1)$ofile)),
+  error = function(e) dirname(normalizePath(
+    sub("--file=", "", grep("--file=", commandArgs(FALSE), value = TRUE)[1]))))
+repo <- Sys.getenv("LIGHTGBM_TPU_PATH",
+                   unset = normalizePath(file.path(script_dir, "..", "..")))
 sys <- import("sys")
 sys$path$insert(0L, repo)
 
