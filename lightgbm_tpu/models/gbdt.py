@@ -269,6 +269,14 @@ class GBDTBooster:
         self.grow_cfg = self.grow_cfg._replace(track_rows=(
             bag_active or goss_active or self.cegb_enabled
             or self.bundle is not None))
+        self._bag_active = bag_active
+        self._goss_active = goss_active
+        # fused-iteration fast path state (built lazily; see
+        # _train_one_iter_fused)
+        self._fused_fn = None
+        self._fused_proto = None
+        self._row_w_ones = None
+        self._fmask_cached = None
 
         # only ONE training matrix ever reaches HBM: bundled when EFB
         # engaged, the plain [F, n] matrix otherwise
@@ -763,6 +771,165 @@ class GBDTBooster:
             g, h = g[None, :], h[None, :]
         return g, h
 
+    # ------------------------------------------------------------------
+    # fused-iteration fast path: one XLA program per boosting iteration
+    # ------------------------------------------------------------------
+    def _fused_ok(self) -> bool:
+        """The fused step covers exactly the deferred-materialization
+        configs (plain gbdt, no valid sets, single mesh-less device) —
+        the same gate as ``defer`` in the eager path — minus the
+        features whose host-side control flow is data-dependent (CEGB's
+        cost-state carry, RenewTreeOutput objectives, GOSS's
+        gradient-dependent sampling, linear leaves)."""
+        cfg = self.cfg
+        return (self.mesh is None
+                and cfg.boosting == "gbdt"
+                and not self.valid_sets
+                and not cfg.linear_tree
+                and not self.cegb_enabled
+                and not self._goss_active
+                and self.objective is not None
+                and not getattr(self.objective, "need_renew", False)
+                # ranking objectives carry host-side per-iteration state
+                # (lambdarank position biases, xendcg's key counter) —
+                # inside a traced program those updates would run once
+                # at trace time and then freeze
+                and not getattr(self.objective, "is_ranking", False))
+
+    def _get_fused_fn(self):
+        if self._fused_fn is not None:
+            return self._fused_fn
+        from ..ops.grow import grow_tree_impl
+
+        gcfg = self.grow_cfg
+        K = self.K
+        obj = self.objective
+        quant = gcfg.quantized and gcfg.stochastic
+        bynode = gcfg.bynode < 1.0
+        base_key = self._base_key
+        bynode_key = self._bynode_key
+
+        # the pending-tree proto (ShapeDtypeStructs for unpack at
+        # flush) is config-static: derive it once by abstract eval
+        # instead of returning the whole dev_tree pytree every call
+        sds = jax.ShapeDtypeStruct((self.n,), jnp.float32)
+        key_sds = jax.ShapeDtypeStruct(self._base_key.shape,
+                                       self._base_key.dtype)
+        # NB: abstract stand-ins only — _feature_mask() here would
+        # consume a host-RNG draw and desync the stream vs eager
+        fmask_sds = jax.ShapeDtypeStruct((self.F,), jnp.bool_)
+        proto, _ = jax.eval_shape(
+            functools.partial(grow_tree_impl, gcfg),
+            self.bins_T, sds, sds, sds,
+            fmask_sds, self.feat_num_bins, self.feat_nan_bin,
+            self.monotone, self.feat_is_cat,
+            key_sds if quant else None,
+            self.interaction_groups, self.forced, None,
+            key_sds if bynode else None, self._bundle_dev)
+        self._fused_proto = proto
+
+        def step(score, it, shrink, row_w, fmask, bins_T, fnb, fnan,
+                 label, weight, monotone, feat_is_cat, igroups, forced,
+                 bundle):
+            g, h = obj.grad_hess(score if K > 1 else score[0], label,
+                                 weight)
+            if K == 1:
+                g, h = g[None, :], h[None, :]
+            # identical key schedule to the eager path (fold_in is a
+            # pure device op, so tracing it keeps streams bit-equal)
+            qk_it = jax.random.fold_in(base_key, it) if quant else None
+            nk_it = jax.random.fold_in(bynode_key, it) if bynode else None
+            new_score = score
+            outs = []
+            for k in range(K):
+                qk = jax.random.fold_in(qk_it, k) if quant else None
+                nk = jax.random.fold_in(nk_it, k) if bynode else None
+                dev_tree, row_leaf = grow_tree_impl(
+                    gcfg, bins_T, g[k], h[k], row_w, fmask, fnb, fnan,
+                    monotone, feat_is_cat, qk, igroups, forced, None,
+                    nk, bundle)
+                vec, cmask = pack_tree_device(dev_tree)
+                contrib = gather_small(dev_tree.leaf_value, row_leaf)
+                # a no-growth tree is replaced by a constant at flush
+                # (AsConstantTree): contribute nothing now
+                contrib = jnp.where(dev_tree.num_leaves > 1, contrib,
+                                    0.0)
+                new_score = new_score.at[k].add(contrib * shrink)
+                outs.append((vec, cmask, dev_tree.num_leaves))
+            return new_score, outs
+
+        # donate the old score buffer (it is consumed) — except on CPU,
+        # where XLA ignores donation and warns
+        donate = () if jax.default_backend() == "cpu" else (0,)
+        self._fused_fn = jax.jit(step, donate_argnums=donate)
+        return self._fused_fn
+
+    def _train_one_iter_fused(self) -> bool:
+        """One boosting iteration as a single device program.
+
+        Host-side RNG consumers (per-tree feature_fraction mask,
+        bagging weights) stay OUTSIDE the program and feed it as
+        arguments so their streams match the eager path exactly; the
+        finished tree comes back the same deferred route
+        (_pending_dev + async copies) the eager defer branch uses."""
+        from ..utils.timer import timed
+
+        cfg = self.cfg
+        it = self.iter_
+        with timed("boosting/bagging"):
+            # evaluate the bagging gate LIVE (not the __init__-time
+            # _bag_active snapshot): reset_parameter may turn bagging
+            # on/off mid-training (LGBM_BoosterResetParameter), and the
+            # eager path's _row_weights re-reads cfg every iteration
+            bag_live = cfg.bagging_freq > 0 and (
+                cfg.bagging_fraction < 1.0
+                or cfg.pos_bagging_fraction < 1.0
+                or cfg.neg_bagging_fraction < 1.0)
+            if bag_live:
+                row_w = self._row_weights(it, None, None)
+            else:
+                if self._row_w_ones is None:
+                    self._row_w_ones = jnp.ones((self.n,), jnp.float32)
+                row_w = self._row_w_ones
+            if cfg.feature_fraction < 1.0:
+                fmask = self._feature_mask()
+            else:
+                if self._fmask_cached is None:
+                    self._fmask_cached = self._feature_mask()
+                fmask = self._fmask_cached
+        fn = self._get_fused_fn()
+        with timed("boosting/fused_iter"):
+            new_score, outs = fn(
+                self.score, jnp.asarray(it, jnp.int32),
+                jnp.asarray(self._shrinkage, jnp.float32), row_w, fmask,
+                self.bins_T, self.feat_num_bins, self.feat_nan_bin,
+                self.label, self.weight, self.monotone, self.feat_is_cat,
+                self.interaction_groups, self.forced, self._bundle_dev)
+        self.score = new_score
+        fold_now = it == 0 and self._fold_bias
+        for k, (vec, cmask, num_leaves) in enumerate(outs):
+            bias = float(self.init_score[k]) if fold_now else 0.0
+            self._defer_tree(vec, cmask, self._fused_proto, num_leaves,
+                             self._shrinkage, bias)
+        self.iter_ += 1
+        return False
+
+    def _defer_tree(self, vec, cmask, proto, num_leaves, shrink,
+                    bias) -> None:
+        """Queue one finished device tree for lazy host materialization
+        (consumed by _flush_pending; shared by the eager defer branch
+        and the fused path — keep the pending-tuple shape in one
+        place)."""
+        try:
+            vec.copy_to_host_async()
+            cmask.copy_to_host_async()
+            num_leaves.copy_to_host_async()
+        except AttributeError:  # non-jax arrays (tests/cpu)
+            pass
+        self._pending_dev.append((vec, cmask, proto, shrink, bias))
+        self._tree_weights.append(1.0)
+        self._nl_async.append(num_leaves)
+
     def train_one_iter(self,
                        custom_grad: Optional[np.ndarray] = None,
                        custom_hess: Optional[np.ndarray] = None) -> bool:
@@ -780,6 +947,16 @@ class GBDTBooster:
             self._nl_async = []
             if custom_grad is None and all(nl <= 1 for nl in nls):
                 return True
+
+        # Fast path: the whole iteration (gradients -> grow -> tree pack
+        # -> contrib gather -> score update) as ONE jitted program. The
+        # decomposition on a real chip (benchmarks/DECOMP_r05.txt)
+        # showed each separate dispatch paying ~15-25 ms of launch
+        # latency through the device tunnel — ~106 ms/iter against a
+        # <1 ms bandwidth floor — so launch count, not FLOPs, was the
+        # second-largest cost of an iteration.
+        if custom_grad is None and self._fused_ok():
+            return self._train_one_iter_fused()
 
         # DART: pick and temporarily drop trees (dart.hpp DroppingTrees)
         drop_idx: List[int] = []
@@ -942,19 +1119,11 @@ class GBDTBooster:
                 # (models property). Bias/shrinkage are re-applied at
                 # materialization in the same order as the eager path.
                 vec, cmask = pack_tree_device(dev_tree)
-                try:
-                    vec.copy_to_host_async()
-                    cmask.copy_to_host_async()
-                    dev_tree.num_leaves.copy_to_host_async()
-                except AttributeError:  # non-jax arrays (tests/cpu)
-                    pass
                 proto = jax.tree.map(
                     lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
                     dev_tree)
-                self._pending_dev.append((vec, cmask, proto,
-                                          shrinkage, bias))
-                self._tree_weights.append(1.0)
-                self._nl_async.append(dev_tree.num_leaves)
+                self._defer_tree(vec, cmask, proto, dev_tree.num_leaves,
+                                 shrinkage, bias)
                 tree = None
             else:
                 if cfg.linear_tree:
