@@ -1,0 +1,46 @@
+"""Quantized-gradient training (use_quantized_grad: int8 stochastic
+rounding, exact int32 MXU histograms — the reference's
+gradient_discretizer.hpp feature) at bench scale on the real chip,
+fused path. Secondary metric: the primary bench stays the reference's
+own (non-quantized) Higgs config. Run:
+    python benchmarks/quant_bench.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import time
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+N, F = 10_500_000, 28
+rs = np.random.RandomState(0)
+X = rs.randn(N, F).astype(np.float32)
+coef = rs.randn(F).astype(np.float32)
+y = ((X @ coef) > 0).astype(np.float64)
+ds = lgb.Dataset(X, label=y, params={"max_bin": 255})
+ds.construct()
+del X
+
+for quant in (False, True):
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 255,
+                              "max_bin": 255, "learning_rate": 0.1,
+                              "verbosity": -1,
+                              "use_quantized_grad": quant},
+                      train_set=ds)
+    eng = bst._engine
+    t0 = time.perf_counter()
+    eng.train_one_iter()
+    eng.score.block_until_ready()
+    wu = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(5):
+        eng.train_one_iter()
+    eng.score.block_until_ready()
+    dt = (time.perf_counter() - t0) / 5
+    print(f"quantized={quant}: {dt * 1e3:.1f} ms/iter "
+          f"({1 / dt:.3f} it/s, vs_baseline "
+          f"{1 / dt / (500 / 130.094):.3f}, warmup {wu:.0f}s)",
+          flush=True)

@@ -722,8 +722,8 @@ class Dataset:
             self.mappers = [full_mappers[j] for j in used]
         self._full_mappers = full_mappers
 
-        cols = [X[:, j] for j in self._used_features]
-        self._bins = bin_values(cols, self.mappers)
+        from .ops.binning import bin_matrix
+        self._bins = bin_matrix(X, self._used_features, self.mappers)
         self._F = len(self.mappers)
         # linear trees fit on raw numerical values (the reference keeps
         # raw data when linear_tree is set — Dataset raw_data_, dataset.h).
@@ -731,8 +731,11 @@ class Dataset:
         # sets of a linear model can be scored.
         if cfg.linear_tree or (self.reference is not None
                                and self.reference.raw_numeric() is not None):
-            self._raw_numeric = np.column_stack(cols).astype(np.float32) \
-                if cols else np.zeros((n, 0), np.float32)
+            self._raw_numeric = (
+                np.asarray(X)[:, self._used_features].astype(
+                    np.float32, copy=False)
+                if len(self._used_features)
+                else np.zeros((n, 0), np.float32))
         else:
             self._raw_numeric = None
 
@@ -1439,6 +1442,14 @@ class Booster:
                       "feature_fraction", "feature_fraction_bynode"):
                 if k in params:
                     setattr(self._engine.cfg, k, params[k])
+            if "feature_fraction_bynode" in params:
+                # bynode is baked into the traced grow program (the
+                # per-node key schedule): refresh the static grow
+                # config and drop the cached fused program so BOTH
+                # paths re-trace with the new setting
+                self._engine.grow_cfg = self._engine.grow_cfg._replace(
+                    bynode=float(params["feature_fraction_bynode"]))
+                self._engine._fused_fn = None
         return self
 
     def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:

@@ -61,7 +61,13 @@ class BinMapper:
 
     # -- mapping ---------------------------------------------------------
     def value_to_bin(self, values: np.ndarray) -> np.ndarray:
-        """Vectorized value->bin (the ValueToBin analog, bin.h:193)."""
+        """Vectorized value->bin (the ValueToBin analog, bin.h:193).
+
+        Pass-count matters: this maps every cell of the training matrix
+        (4228 columns at Allstate width), so NaN handling is gated on
+        the mapper's missing_type instead of paying isnan+where passes
+        on clean columns, and the searchsorted result is clamped/cast
+        in one pass."""
         values = np.asarray(values, dtype=np.float64)
         if self.bin_type == BinType.CATEGORICAL:
             out = np.zeros(values.shape, dtype=np.int32)
@@ -69,14 +75,24 @@ class BinMapper:
             for cat, b in (self.cat_to_bin or {}).items():
                 out[iv == cat] = b
             return out
+        if self.missing_type == MissingType.NAN:
+            nan_mask = np.isnan(values)
+            bins = np.searchsorted(self.upper_bounds, values, side="left")
+            np.minimum(bins, len(self.upper_bounds) - 1, out=bins)
+            bins = np.where(nan_mask, self.num_bins - 1, bins)
+            return bins.astype(np.int32)
+        # no NaN bin: a clean column skips the isnan/where passes
+        # entirely (NaN compares unordered, so searchsorted already
+        # sends NaN past every bound; the clamp folds it to the last
+        # bin — same result as the old where(nan, 0.0) under
+        # MissingType.NONE/ZERO because bin 0 semantics only matter
+        # for zero_as_missing, handled at find_bin time)
         nan_mask = np.isnan(values)
-        if self.missing_type != MissingType.NAN:
+        if nan_mask.any():
             values = np.where(nan_mask, 0.0, values)
         bins = np.searchsorted(self.upper_bounds, values, side="left")
-        bins = np.minimum(bins, len(self.upper_bounds) - 1).astype(np.int32)
-        if self.missing_type == MissingType.NAN:
-            bins = np.where(nan_mask, self.num_bins - 1, bins)
-        return bins
+        np.minimum(bins, len(self.upper_bounds) - 1, out=bins)
+        return bins.astype(np.int32)
 
     def bin_to_value(self, b: int) -> float:
         """Representative value of a bin (used for threshold realization)."""
@@ -348,4 +364,55 @@ def bin_values(columns: Sequence[np.ndarray], mappers: Sequence[BinMapper],
     out = np.zeros((n, len(columns)), dtype=dtype)
     for j, (col, m) in enumerate(zip(columns, mappers)):
         out[:, j] = m.value_to_bin(col).astype(dtype)
+    return out
+
+
+def bin_matrix(X: np.ndarray, col_indices, mappers: Sequence[BinMapper],
+               dtype=None) -> np.ndarray:
+    """Bin selected columns of a row-major [n, F] values matrix into a
+    dense [n, C] bin matrix.
+
+    Numerical columns go through the native C++ kernel when available
+    (utils/native.py ltpu_bin_columns — the reference also bins with
+    compiled code, bin.h ValueToBin): the numpy per-column path costs
+    ~100-160 ns/value in call dispatch and strided access, which at
+    Allstate width (4228 columns) made construct the wall-clock
+    bottleneck (benchmarks/PROFILE.md round 5). Categorical columns
+    (dict lookups) and unsupported dtypes fall back to value_to_bin;
+    results are bit-identical either way."""
+    col_indices = np.asarray(col_indices, np.int64)
+    max_bins = max((m.num_bins for m in mappers), default=2)
+    if dtype is None:
+        dtype = np.uint8 if max_bins <= 256 else np.uint16
+    n = X.shape[0]
+    num_sel = [i for i, m in enumerate(mappers)
+               if m.bin_type == BinType.NUMERICAL]
+    sub = None
+    if num_sel and isinstance(X, np.ndarray) \
+            and X.dtype in (np.float32, np.float64) \
+            and X.flags.c_contiguous:
+        from ..utils.native import bin_columns_native
+        bounds_list = [mappers[i].upper_bounds for i in num_sel]
+        nan_to = np.asarray(
+            [mappers[i].num_bins - 1
+             if mappers[i].missing_type == MissingType.NAN
+             else min(int(np.searchsorted(mappers[i].upper_bounds, 0.0,
+                                          side="left")),
+                      len(mappers[i].upper_bounds) - 1)
+             for i in num_sel], np.int32)
+        sub = bin_columns_native(
+            X, col_indices[num_sel].astype(np.int32), bounds_list,
+            nan_to, dtype)
+    if sub is not None and len(num_sel) == len(mappers):
+        return sub
+    out = np.zeros((n, len(mappers)), dtype=dtype)
+    if sub is not None:
+        out[:, num_sel] = sub
+        sel = set(num_sel)
+        rest = [i for i in range(len(mappers)) if i not in sel]
+    else:
+        rest = range(len(mappers))
+    for i in rest:
+        out[:, i] = mappers[i].value_to_bin(
+            X[:, col_indices[i]]).astype(dtype)
     return out

@@ -93,6 +93,15 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_char,
         ctypes.c_int64, ctypes.c_int64,
         np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")]
+    lib.ltpu_bin_columns.restype = None
+    lib.ltpu_bin_columns.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ctypes.c_void_p, ctypes.c_int]
     _LIB = lib
     return lib
 
@@ -119,4 +128,42 @@ def parse_dense_text(path: str, skip_header: bool) -> Optional[np.ndarray]:
                                delim.value, rows.value, cols.value, out)
     if got != rows.value:
         out = out[:got]
+    return out
+
+
+def bin_columns_native(X: np.ndarray, col_indices: np.ndarray,
+                       bounds_list, nan_to: np.ndarray,
+                       out_dtype) -> Optional[np.ndarray]:
+    """Bin numerical columns of a row-major matrix with the native
+    kernel (ltpu_bin_columns); None when native is unavailable or the
+    matrix dtype is unsupported (caller falls back to numpy).
+
+    ``bounds_list``: per-selected-column float64 ascending upper
+    bounds; ``nan_to``: per-selected-column target bin for NaN cells.
+    """
+    lib = _load()
+    if lib is None or X.ndim != 2:
+        return None
+    if X.dtype == np.float32:
+        is_f64 = 0
+    elif X.dtype == np.float64:
+        is_f64 = 1
+    else:
+        return None
+    X = np.ascontiguousarray(X)
+    n, F = X.shape
+    C = len(col_indices)
+    bnd_off = np.zeros((C + 1,), np.int64)
+    for i, b in enumerate(bounds_list):
+        bnd_off[i + 1] = bnd_off[i] + len(b)
+    bounds = np.concatenate(bounds_list).astype(np.float64) \
+        if C else np.zeros((0,), np.float64)
+    out = np.empty((n, C), out_dtype)
+    lib.ltpu_bin_columns(
+        X.ctypes.data_as(ctypes.c_void_p), is_f64, n, F,
+        np.ascontiguousarray(col_indices, np.int32), C,
+        np.ascontiguousarray(bounds), bnd_off,
+        np.ascontiguousarray(nan_to, np.int32),
+        out.ctypes.data_as(ctypes.c_void_p),
+        int(out.dtype == np.uint16))
     return out

@@ -196,6 +196,36 @@ def test_fused_bagging_toggle_mid_training(data):
                for ta, tc in zip(a._models[3:], c._models[3:]))
 
 
+def test_fused_bynode_reset_mid_training(data):
+    """feature_fraction_bynode is baked into the traced grow program;
+    reset_parameter must re-trace BOTH paths (refresh grow_cfg, drop
+    the cached fused program) so they keep matching."""
+    X, y = data
+
+    def run(fused):
+        if not fused:
+            orig = GBDTBooster._fused_ok
+            GBDTBooster._fused_ok = lambda self: False
+        try:
+            bst = lgb.Booster(
+                params={"objective": "binary", "num_leaves": 15,
+                        "feature_fraction_bynode": 0.7, "verbosity": -1},
+                train_set=lgb.Dataset(X, label=y))
+            for _ in range(3):
+                bst._engine.train_one_iter()
+            bst.reset_parameter({"feature_fraction_bynode": 1.0})
+            for _ in range(3):
+                bst._engine.train_one_iter()
+            return bst
+        finally:
+            if not fused:
+                GBDTBooster._fused_ok = orig
+
+    a, b = run(True), run(False)
+    assert a._engine._fused_fn is not None
+    _assert_same_model(a, b)
+
+
 def test_fused_init_model_continuation(data):
     """Training continued from a saved model (init_model) goes through
     preload_models; the fused path must keep producing the same trees
