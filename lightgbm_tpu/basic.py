@@ -1443,13 +1443,32 @@ class Booster:
                 if k in params:
                     setattr(self._engine.cfg, k, params[k])
             if "feature_fraction_bynode" in params:
-                # bynode is baked into the traced grow program (the
+                # bynode is baked into the traced grow programs (the
                 # per-node key schedule): refresh the static grow
-                # config and drop the cached fused program so BOTH
-                # paths re-trace with the new setting
-                self._engine.grow_cfg = self._engine.grow_cfg._replace(
-                    bynode=float(params["feature_fraction_bynode"]))
-                self._engine._fused_fn = None
+                # config and drop/rebuild every cached program —
+                # fused, eager (reads grow_cfg per call), and the
+                # distributed grow fn — so all three re-trace with
+                # the new setting
+                eng = self._engine
+                bynode = float(params["feature_fraction_bynode"])
+                gcfg = eng.grow_cfg._replace(bynode=bynode)
+                if bynode < 1.0 and gcfg.grower != "compact":
+                    # same coercion as engine init: per-node column
+                    # sampling lives on the compact grower only
+                    gcfg = gcfg._replace(grower="compact")
+                eng.grow_cfg = gcfg
+                eng._fused_fn = None
+                if eng._grow_fn is not None:
+                    from .parallel.data_parallel import make_dp_grow_fn
+                    eng._grow_fn = make_dp_grow_fn(
+                        gcfg, eng.mesh, eng.monotone is not None,
+                        eng.feat_is_cat is not None,
+                        eng.cfg.use_quantized_grad
+                        and eng.cfg.stochastic_rounding,
+                        eng.interaction_groups is not None,
+                        eng.forced is not None,
+                        bynode < 1.0,
+                        has_bundle=eng.bundle is not None)
         return self
 
     def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:

@@ -81,12 +81,10 @@ class BinMapper:
             np.minimum(bins, len(self.upper_bounds) - 1, out=bins)
             bins = np.where(nan_mask, self.num_bins - 1, bins)
             return bins.astype(np.int32)
-        # no NaN bin: a clean column skips the isnan/where passes
-        # entirely (NaN compares unordered, so searchsorted already
-        # sends NaN past every bound; the clamp folds it to the last
-        # bin — same result as the old where(nan, 0.0) under
-        # MissingType.NONE/ZERO because bin 0 semantics only matter
-        # for zero_as_missing, handled at find_bin time)
+        # MissingType.NONE/ZERO: NaN cells map to the bin of 0.0 (the
+        # where -> searchsorted(0.0) below; the native kernel hardcodes
+        # the same via nan_to). A clean column pays one isnan read pass
+        # but skips the where copy.
         nan_mask = np.isnan(values)
         if nan_mask.any():
             values = np.where(nan_mask, 0.0, values)

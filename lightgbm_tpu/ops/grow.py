@@ -569,6 +569,73 @@ def _row_leaf_from_order(order, leaf_of_pos):
     return row_leaf
 
 
+# Variadic-sort width management for the chunk partition. The TPU
+# backend's codegen for one variadic sort degrades SUPER-LINEARLY in
+# operand count — measured on v5e (16K rows, compile seconds):
+#   5-operand 7.0 | 9-op 15.0 | 13-op 25.0 | 17-op: minutes each |
+#   168-op (Allstate EFB width, 667 bundle columns packed 4/word):
+#   never returned within 2.5 h.
+# (CPU-backend compile stays seconds at every width, so it is the TPU
+# sort codegen, not XLA frontend passes.) Splitting into small-group
+# sorts that each re-sort the SAME key is result-identical — the key
+# (side*CK + lane) is unique per row, so every group sort computes the
+# same permutation — at the cost of one extra key column of VMEM
+# traffic per group. Narrow datasets (the Higgs shape: 8-9 payload
+# operands) keep the proven single sort; wide ones pay ~12% more sort
+# traffic to make compile linear in width (~15 s per 9-operand group,
+# one-time with the persistent compilation cache).
+_SORT_SINGLE_MAX = 12
+_SORT_GROUP = 8
+
+
+def _sort_by_key(key, cols):
+    """Multi-operand sort by a UNIQUE key, group-split past
+    _SORT_SINGLE_MAX payload operands (see note above). Returns
+    (sorted_key, *sorted_cols) like lax.sort((key,) + cols).
+
+    The wide path VMAPS one _SORT_GROUP-operand sort over the groups
+    (same-dtype columns stacked [G, group, n], key broadcast) so the
+    whole partition lowers to ONE batched sort HLO per dtype — compile
+    cost is then CONSTANT in width, where even a Python loop of small
+    sorts still compiled super-additively (F=256: 9 loop sorts ≈
+    520 s; the batched form is the narrow program's ~15 s)."""
+    cols = tuple(cols)
+    if len(cols) <= _SORT_SINGLE_MAX:
+        return lax.sort((key,) + cols, num_keys=1)
+    by_dtype: dict = {}
+    for i, c in enumerate(cols):
+        by_dtype.setdefault(jnp.dtype(c.dtype), []).append(i)
+    out = [None] * len(cols)
+    key_sorted = None
+    for dt, idxs in by_dtype.items():
+        arrs = [cols[i] for i in idxs]
+        if len(arrs) <= _SORT_GROUP:
+            res = lax.sort((key,) + tuple(arrs), num_keys=1)
+            if key_sorted is None:
+                key_sorted = res[0]
+            for j, i in enumerate(idxs):
+                out[i] = res[1 + j]
+            continue
+        G = -(-len(arrs) // _SORT_GROUP)
+        pad = G * _SORT_GROUP - len(arrs)
+        stack = jnp.stack(arrs + [arrs[-1]] * pad)
+        stack = stack.reshape(G, _SORT_GROUP, key.shape[0])
+        keyb = jnp.broadcast_to(key, (G,) + key.shape)
+
+        def _one(k, ws):
+            r = lax.sort((k,) + tuple(ws[i] for i in
+                                      range(_SORT_GROUP)), num_keys=1)
+            return r[0], jnp.stack(r[1:])
+
+        ks, ws = jax.vmap(_one)(keyb, stack)
+        if key_sorted is None:
+            key_sorted = ks[0]
+        flat = ws.reshape(G * _SORT_GROUP, key.shape[0])
+        for j, i in enumerate(idxs):
+            out[i] = flat[j]
+    return (key_sorted,) + tuple(out)
+
+
 def _grow_compact_impl(cfg: GrowConfig,
                        bins_T: jnp.ndarray,
                        grad: jnp.ndarray,
@@ -1343,11 +1410,11 @@ def _grow_compact_impl(cfg: GrowConfig,
                         lo = lops[NW + NPAY]
                         ro = rops[NW + NPAY]
                 else:
-                    # stable in-chunk partition: one variadic sort
-                    # moving all row data by a (side, position) key
+                    # stable in-chunk partition: variadic sort moving
+                    # all row data by a (side, position) key
                     side = jnp.where(vl, 0, jnp.where(valid, 1, 2))
                     key = side * CK + iota_c
-                    ops = lax.sort((key,) + cols, num_keys=1)
+                    ops = _sort_by_key(key, cols)
                     lb = jnp.stack(ops[1:1 + NW], axis=1)
                     lp = _unpack_pay(ops[1 + NW:1 + NW + NPAY])
                     # rights [l_c, l_c+r_c) rotated to the block END

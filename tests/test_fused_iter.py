@@ -239,3 +239,29 @@ def test_fused_init_model_continuation(data):
                      init_model=half)
     assert len(cont._models) == 6
     _assert_same_model(full, cont)
+
+
+def test_bynode_reset_rebuilds_distributed_grow_fn(data):
+    """reset_parameter('feature_fraction_bynode') under mesh training:
+    the distributed grow fn bakes grow_cfg + a has_node_key flag at
+    build time, so the reset must rebuild it (not just the fused/eager
+    paths) — enabling bynode mid-training used to crash with an arity
+    mismatch, disabling silently kept sampling."""
+    import jax
+    if len(jax.devices()) < 2:
+        import pytest
+        pytest.skip("needs the multi-device CPU mesh")
+    X, y = data
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 15,
+                              "tree_learner": "data", "verbosity": -1},
+                      train_set=lgb.Dataset(X, label=y))
+    for _ in range(2):
+        bst._engine.train_one_iter()
+    bst.reset_parameter({"feature_fraction_bynode": 0.6})
+    for _ in range(2):
+        bst._engine.train_one_iter()
+    bst.reset_parameter({"feature_fraction_bynode": 1.0})
+    for _ in range(2):
+        bst._engine.train_one_iter()
+    assert len(bst._models) == 6
+    assert np.isfinite(bst.predict(X[:100])).all()
