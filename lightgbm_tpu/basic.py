@@ -1459,16 +1459,7 @@ class Booster:
                 eng.grow_cfg = gcfg
                 eng._fused_fn = None
                 if eng._grow_fn is not None:
-                    from .parallel.data_parallel import make_dp_grow_fn
-                    eng._grow_fn = make_dp_grow_fn(
-                        gcfg, eng.mesh, eng.monotone is not None,
-                        eng.feat_is_cat is not None,
-                        eng.cfg.use_quantized_grad
-                        and eng.cfg.stochastic_rounding,
-                        eng.interaction_groups is not None,
-                        eng.forced is not None,
-                        bynode < 1.0,
-                        has_bundle=eng.bundle is not None)
+                    eng._grow_fn = eng._build_grow_fn()
         return self
 
     def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:

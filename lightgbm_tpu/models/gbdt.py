@@ -337,17 +337,30 @@ class GBDTBooster:
             if self._pad:
                 self.bins_T = jnp.pad(self.bins_T,
                                       ((0, 0), (0, self._pad)))
-            self._grow_fn = make_dp_grow_fn(
-                self.grow_cfg, self.mesh, self.monotone is not None,
-                self.feat_is_cat is not None,
-                cfg.use_quantized_grad and cfg.stochastic_rounding,
-                self.interaction_groups is not None,
-                self.forced is not None,
-                cfg.feature_fraction_bynode < 1.0,
-                has_bundle=self.bundle is not None)
+            self._grow_fn = self._build_grow_fn()
 
         seed = cfg.seed if cfg.seed is not None else 0
         self._base_key = jax.random.PRNGKey(seed)
+        self._init_keys_and_rngs(cfg)
+
+    def _build_grow_fn(self):
+        """Distributed grow fn from the CURRENT grow_cfg + capability
+        flags — the single source for both engine init and
+        reset_parameter rebuilds (the flag list must match the grow
+        call's argument assembly in train_one_iter)."""
+        from ..parallel.data_parallel import make_dp_grow_fn
+
+        cfg = self.cfg
+        return make_dp_grow_fn(
+            self.grow_cfg, self.mesh, self.monotone is not None,
+            self.feat_is_cat is not None,
+            cfg.use_quantized_grad and cfg.stochastic_rounding,
+            self.interaction_groups is not None,
+            self.forced is not None,
+            self.grow_cfg.bynode < 1.0,
+            has_bundle=self.bundle is not None)
+
+    def _init_keys_and_rngs(self, cfg):
         # distinct stream for per-node column sampling (ColSampler's
         # feature_fraction_seed, col_sampler.hpp)
         self._bynode_key = jax.random.PRNGKey(cfg.feature_fraction_seed)
