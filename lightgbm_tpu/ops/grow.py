@@ -528,6 +528,16 @@ class _CompactState(NamedTuple):
                              # (leaf2slot [L] i32, -1 = evicted;
                              #  slot2leaf [P] i32, -1 = free;
                              #  lru [P] i32 last-use split tick)
+    pcache: jnp.ndarray = () # [F, B, 2] prefetched parent histogram of
+                             # the NEXT split's leaf (non-pooled only).
+                             # Reading the parent from the carry instead
+                             # of `hists[leaf]` removes the only
+                             # pre-update use of `hists` in the loop
+                             # body, so XLA aliases the two child
+                             # dynamic-update-slices in place instead of
+                             # copying the whole [L, F, B, 2] buffer
+                             # twice per split (measured: 2x 14.6 MB at
+                             # Higgs, 2x 167 MB at Allstate width)
 
 
 _IB_BIT = jnp.uint32(1 << 31)
@@ -1235,9 +1245,14 @@ def _grow_compact_impl(cfg: GrowConfig,
         feature-parallel — ONLY this device's NWl-word block (F/D of
         the one-hot/matmul work)."""
         if fp:
-            blk = lax.dynamic_slice(w32, (pos0, w_start), (CK, NWl))
+            if wide_part:
+                blk = lax.dynamic_slice(_bins_slice(w32, pos0, CK),
+                                        (jnp.int32(0), w_start),
+                                        (CK, NWl))
+            else:
+                blk = lax.dynamic_slice(w32, (pos0, w_start), (CK, NWl))
             return _unpack_words(blk)                     # [CK, Fl]
-        blk = lax.dynamic_slice(w32, (pos0, 0), (CK, NW))
+        blk = _bins_slice(w32, pos0, CK)
         return _unpack_words(blk)[:, :F]
 
     def rot(a, s):
@@ -1288,6 +1303,48 @@ def _grow_compact_impl(cfg: GrowConfig,
         NPAY = 2
 
     SEG = n + 2 * PAD  # rows per ping-pong half (PAD rows both sides)
+
+    # WIDE partition mode (round 5): at EFB width the per-chunk
+    # partition permutes rows with a (key, iota) sort + row GATHERS of
+    # the packed words instead of carrying all NW word columns through
+    # the variadic sort (which costs O(NW) traffic per bitonic stage —
+    # 0.77 ms/chunk at NW=167 vs 35 us at Higgs width). The gather and
+    # its DUS writeback want the ROW-MAJOR layout, while the histogram
+    # one-hot wants rows minor; storing bins2 FLAT (1-D) pins the
+    # row-major linearization globally, so XLA relayouts only
+    # chunk-sized hist inputs instead of transposing the whole
+    # multi-hundred-MB ping-pong buffer twice per chunk (measured
+    # in-situ: the whole-buffer copies were 1.7 s/tree at 131K x 665).
+    # (the 2**31 guard: flat offsets are int32 products pos*NW — past
+    # ~2^31 elements they would wrap and silently corrupt the
+    # partition, so such shapes — which exceed v5e HBM anyway — keep
+    # the group-sort path)
+    wide_part = (not route) \
+        and NW + NPAY + (1 if track else 0) > _SORT_SINGLE_MAX \
+        and 2 * (n + 2 * PAD) * NW < 2 ** 31
+
+    def _bins_slice(w32, pos0, CK):
+        """[CK, NW] chunk of the packed words at row offset pos0
+        (the ndim check keeps the root-hist pass, which reads the
+        pre-pad 2-D [n, NW] block, on the plain slice)."""
+        if wide_part and w32.ndim == 1:
+            return lax.dynamic_slice(
+                w32, (pos0 * NW,), (CK * NW,)).reshape(CK, NW)
+        return lax.dynamic_slice(w32, (pos0, 0), (CK, NW))
+
+    def _bins_write(arr, off, block, m):
+        """Masked RMW of a [CK, NW] block at row offset ``off``
+        (the wide mode addresses the flat buffer)."""
+        if not wide_part:
+            cur = lax.dynamic_slice(arr, (off, 0), block.shape)
+            out = jnp.where(m[:, None], block, cur)
+            return lax.dynamic_update_slice(arr, out, (off, 0))
+        CK = block.shape[0]
+        cur = lax.dynamic_slice(
+            arr, (off * NW,), (CK * NW,)).reshape(CK, NW)
+        out = jnp.where(m[:, None], block, cur)
+        return lax.dynamic_update_slice(arr, out.reshape(-1),
+                                        (off * NW,))
 
     def chunk_hist(bins2, pay2, pos0, limit, CK):
         """Histogram of one CK-row chunk at dynamic row offset ``pos0``:
@@ -1369,7 +1426,7 @@ def _grow_compact_impl(cfg: GrowConfig,
                  l_off, r_off, nlib, nib) = carry
                 off = base_off + c * CK
                 pos0 = src_base + off
-                blk_w = lax.dynamic_slice(bins2, (pos0, 0), (CK, NW))
+                blk_w = _bins_slice(bins2, pos0, CK)
                 blk_p = lax.dynamic_slice(pay2, (pos0, 0), (CK, C))
                 split_col = _extract_col(blk_w,
                                          bundle_of[f] if bundled else f)
@@ -1417,6 +1474,56 @@ def _grow_compact_impl(cfg: GrowConfig,
                     if track:
                         lo = lops[NW + NPAY]
                         ro = rops[NW + NPAY]
+                elif wide_part:
+                    # WIDE partition (round 5): a variadic sort moves
+                    # every operand through every bitonic stage, so at
+                    # EFB width (Allstate: NW=167 word columns) the sort
+                    # alone measured 0.77 ms/chunk vs 35 us at Higgs
+                    # width. Instead sort ONLY (key, iota) to get the
+                    # permutation, then apply it with row GATHERS of the
+                    # packed [CK, ~NW] word block — one pass of traffic
+                    # instead of O(log^2 CK) stage passes. Rows here are
+                    # NW*4-byte contiguous runs, wide enough to gather
+                    # at vector width (at Higgs width rows are ~28 B and
+                    # the payload-carrying sort wins — hence the gate).
+                    side = jnp.where(vl, 0, jnp.where(valid, 1, 2))
+                    key = side * CK + iota_c
+                    perm = lax.sort((key, iota_c.astype(jnp.int32)),
+                                    num_keys=1)[1]
+                    s_r = lax.rem(l_c + r_c, jnp.asarray(CK, jnp.int32))
+                    perm_r = rot(perm, s_r)
+                    # fold the payload (and ord) into the word block so
+                    # ONE row gather moves everything; the (g, h) pair
+                    # is already a single u32 word on the TPU paths
+                    # (bf16 pair / quant int8 pair), and the f32 CPU
+                    # pair bitcasts to two u32 words
+                    if quant:
+                        pw = _pack_pay(blk_p)[0].astype(jnp.uint32)[:, None]
+                    elif bf16_pay:
+                        pw = _pack_pay(blk_p)[0][:, None]
+                    else:
+                        pw = None                  # separate-gather pay
+                    parts = [blk_w] + ([pw] if pw is not None else [])
+                    if track:
+                        parts.append(blk_o[:, None])
+                    blk_all = parts[0] if len(parts) == 1 \
+                        else jnp.concatenate(parts, axis=1)
+                    la = jnp.take(blk_all, perm, axis=0)
+                    ra = jnp.take(blk_all, perm_r, axis=0)
+                    PW = 0 if pw is None else 1
+                    lb, rb = la[:, :NW], ra[:, :NW]
+                    if quant:
+                        lp = _unpack_pay((la[:, NW].astype(jnp.uint16),))
+                        rp = _unpack_pay((ra[:, NW].astype(jnp.uint16),))
+                    elif bf16_pay:
+                        lp = _unpack_pay((la[:, NW],))
+                        rp = _unpack_pay((ra[:, NW],))
+                    else:
+                        lp = jnp.take(blk_p, perm, axis=0)
+                        rp = jnp.take(blk_p, perm_r, axis=0)
+                    if track:
+                        lo = la[:, NW + PW]
+                        ro = ra[:, NW + PW]
                 else:
                     # stable in-chunk partition: variadic sort moving
                     # all row data by a (side, position) key
@@ -1433,9 +1540,9 @@ def _grow_compact_impl(cfg: GrowConfig,
                         ro = rot(lo, s_r)
                 # lefts [0, l_c) forward in place; rights packed
                 # backward from the window end in the other half
-                bins2 = write(bins2, src_base + l_off, lb, ml)
+                bins2 = _bins_write(bins2, src_base + l_off, lb, ml)
                 pay2 = write(pay2, src_base + l_off, lp, ml)
-                bins2 = write(bins2, o_r, rb, mr)
+                bins2 = _bins_write(bins2, o_r, rb, mr)
                 pay2 = write(pay2, o_r, rp, mr)
                 if track:
                     ord2 = write(ord2, src_base + l_off, lo, ml)
@@ -1654,9 +1761,11 @@ def _grow_compact_impl(cfg: GrowConfig,
     ord0 = (jnp.arange(n, dtype=jnp.uint32)
             | jnp.where(inbag, _IB_BIT, jnp.uint32(0))) if track \
         else jnp.zeros((2,), jnp.uint32)
+    bins2_0 = jnp.pad(bins_pk, ((PAD, PAD + SEG), (0, 0)))
     state = _CompactState(
         tree=tree, best=best, hists=hists,
-        bins2=jnp.pad(bins_pk, ((PAD, PAD + SEG), (0, 0))),
+        # the wide partition stores the words FLAT (see wide_part)
+        bins2=bins2_0.reshape(-1) if wide_part else bins2_0,
         pay2=jnp.pad(pay0, ((PAD, PAD + SEG), (0, 0))),
         ord2=jnp.pad(ord0, (PAD, PAD + SEG)) if track else ord0,
         leaf_buf=jnp.zeros((L,), jnp.int32),
@@ -1665,7 +1774,10 @@ def _grow_compact_impl(cfg: GrowConfig,
         branch=jnp.zeros((L, F_orig), jnp.bool_),
         num_splits=jnp.asarray(0, jnp.int32),
         cegb=cegb_state, mono=mono_state, node_masks=nmask_state,
-        pool=pool_state)
+        pool=pool_state,
+        # the first split's leaf is 0 (only the root has a stored
+        # candidate), so the prefetched parent is the root histogram
+        pcache=(jnp.zeros((1,), hists.dtype) if pooled else root_hist))
 
     def depth_ok(d):
         if cfg.max_depth <= 0:
@@ -1803,7 +1915,7 @@ def _grow_compact_impl(cfg: GrowConfig,
                  leaf_override=None) -> _CompactState:
         (tree, best, hists, bins2, pay2, ord2, leaf_buf,
          lbegin, lcount, branch, ns, cegb_st, mono_st, nmask_st,
-         pool_st) = state
+         pool_st, pcache) = state
         leaf = jnp.argmax(best.gain).astype(jnp.int32) \
             if leaf_override is None else leaf_override
         R = ns + 1
@@ -1829,7 +1941,15 @@ def _grow_compact_impl(cfg: GrowConfig,
                 lambda: lax.dynamic_index_in_dim(
                     hists, jnp.maximum(slot_l, 0), keepdims=False),
                 lambda: window_hist(bins2, pay2, src, start, cnt))
+        elif leaf_override is None:
+            # the prefetched parent (see _CompactState.pcache): the
+            # only read of `hists` in the main-loop body now happens
+            # AFTER the child updates, so they alias in place
+            parent_hist = pcache
         else:
+            # forced splits run OUTSIDE the while loop (Python
+            # unrolled), where the direct read costs one copy at most
+            # M times
             parent_hist = hists[leaf]
 
         # -- partition the leaf's range (DataPartition::Split analog) +
@@ -2059,13 +2179,23 @@ def _grow_compact_impl(cfg: GrowConfig,
                                        mono_st, nmask_st, pool_ctx),
                 lambda b: b, best)
 
+        if pooled:
+            new_pcache = pcache
+        else:
+            # prefetch the NEXT split's parent from the updated buffer
+            # (the argmax here is exactly the next iteration's leaf
+            # choice — best is final at this point)
+            nl_next = jnp.argmax(best.gain).astype(jnp.int32)
+            new_pcache = lax.dynamic_index_in_dim(hists, nl_next,
+                                                  keepdims=False)
         return _CompactState(tree=tree, best=best, hists=hists,
                              bins2=bins2, pay2=pay2, ord2=ord2,
                              leaf_buf=leaf_buf,
                              leaf_begin=lbegin, leaf_count=lcount,
                              branch=branch, num_splits=ns + 1,
                              cegb=cegb_st, mono=mono_st,
-                             node_masks=nmask_st, pool=pool_st)
+                             node_masks=nmask_st, pool=pool_st,
+                             pcache=new_pcache)
 
     def forced_result(hist, tc, f, t, p_out, bnds) -> SplitResult:
         """Fixed (feature, bin) split record from a leaf's histogram

@@ -154,3 +154,54 @@ def test_grower_nibble_packed_low_bin():
                        lgb.Dataset(X, label=y), num_boost_round=4)
     np.testing.assert_allclose(compact.predict(X[:400]),
                                masked.predict(X[:400]), rtol=1e-5)
+
+
+def test_grower_wide_gather_equals_sort(monkeypatch):
+    """The wide partition (sort (key, iota) + row gathers of the packed
+    words; grow.py make_body) must be bit-identical to the
+    payload-carrying sort it replaces past _SORT_SINGLE_MAX operands.
+    F=64 u8 -> NW=16 word columns engages the gather path at the
+    default threshold; forcing the threshold sky-high re-takes the
+    sort path on the identical inputs."""
+    import lightgbm_tpu.ops.grow as growmod
+    rs = np.random.RandomState(7)
+    F, n = 64, 5000
+    bins_T = jnp.asarray(rs.randint(0, 64, size=(F, n), dtype=np.uint8))
+    grad = jnp.asarray(rs.randn(n).astype(np.float32))
+    hess = jnp.asarray((np.abs(rs.randn(n)) + 0.1).astype(np.float32))
+    t_g, rl_g = _grow("sort", bins_T, grad, hess)
+    monkeypatch.setattr(growmod, "_SORT_SINGLE_MAX", 10_000)
+    t_s, rl_s = _grow("sort", bins_T, grad, hess)
+    assert np.array_equal(np.asarray(rl_g), np.asarray(rl_s))
+    for a, b in zip(t_g, t_s):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_grower_wide_gather_equals_sort_tracked_bf16(monkeypatch):
+    """Same A/B with the ord2-tracking + packed-payload variant (the
+    bundled/TPU configuration folds pay and ord into the gathered word
+    block — exercise that lane too)."""
+    import lightgbm_tpu.ops.grow as growmod
+    rs = np.random.RandomState(8)
+    F, n = 64, 4096
+    bins_T = jnp.asarray(rs.randint(0, 64, size=(F, n), dtype=np.uint8))
+    grad = jnp.asarray(rs.randn(n).astype(np.float32))
+    hess = jnp.asarray((np.abs(rs.randn(n)) + 0.1).astype(np.float32))
+
+    def grow_tracked():
+        cfg = GrowConfig(num_leaves=31, num_bins=64,
+                         split=SplitParams(), hist_method="scatter",
+                         grower="compact", chunk=512, partition="sort",
+                         track_rows=True)
+        return grow_tree(cfg, bins_T, grad, hess,
+                         jnp.ones((n,), jnp.float32),
+                         jnp.ones((F,), bool),
+                         jnp.full((F,), 64, jnp.int32),
+                         jnp.full((F,), -1, jnp.int32))
+
+    t_g, rl_g = grow_tracked()
+    monkeypatch.setattr(growmod, "_SORT_SINGLE_MAX", 10_000)
+    t_s, rl_s = grow_tracked()
+    assert np.array_equal(np.asarray(rl_g), np.asarray(rl_s))
+    for a, b in zip(t_g, t_s):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
