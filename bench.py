@@ -262,6 +262,28 @@ def main():
                           os.path.expanduser("~/.cache/lightgbm_tpu/xla"))
     jax = _probe_backend()
     import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs as lgb_obs
+    from lightgbm_tpu.utils.timer import Timer as _PhaseTimer
+
+    # stdout belongs to the ONE JSON result line (driver contract,
+    # tests/test_bench_contract.py). The package logger defaults to
+    # stdout, and e.g. the native fastparse build-failure warning would
+    # land there — route all library logging to stderr for the run.
+    import logging
+    _blog = logging.getLogger("lightgbm_tpu_bench")
+    if not _blog.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter("%(message)s"))
+        _blog.addHandler(h)
+        _blog.setLevel(logging.INFO)
+    lgb.register_logger(_blog)
+
+    # run telemetry rides along in the one JSON line: phase wall times
+    # (host-side Timer, ~µs/phase against ~100ms iterations), jit
+    # recompile count and HBM gauges — the numbers the perf ROADMAP
+    # items report against (docs/OBSERVABILITY.md)
+    _PhaseTimer.enable()
+    recompile_watch = lgb_obs.RecompileWatcher()
 
     if _ALLSTATE:
         # train/valid generated separately so peak host RSS is
@@ -335,6 +357,15 @@ def main():
         result["efb_bundles"] = len(b.groups)
         result["hbm_bin_bytes"] = int(bst._engine.bins_T.size
                                       * bst._engine.bins_T.dtype.itemsize)
+    phases = _PhaseTimer.snapshot()
+    top_phases = sorted(phases.items(), key=lambda kv: -kv[1]["total"])[:8]
+    result["telemetry"] = {
+        "recompiles": recompile_watch.delta(),
+        "phases": {label: {"total": round(v["total"], 4),
+                           "count": int(v["count"])}
+                   for label, v in top_phases},
+        "hbm": lgb_obs.device_memory_stats(),
+    }
     if result_auc is not None:
         result["auc"] = round(result_auc, 6)
         oracle_config = (N_FEATURES == 28 and NUM_LEAVES == 255

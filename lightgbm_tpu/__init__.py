@@ -10,7 +10,7 @@ API mirroring the reference python-package.
 
 from .basic import Booster, Dataset, LightGBMError, Sequence
 from .callback import (EarlyStopException, early_stopping, log_evaluation,
-                       record_evaluation, reset_parameter)
+                       record_evaluation, reset_parameter, telemetry)
 from .config import Config
 from .engine import CVBooster, cv, train
 from .utils.log import register_logger
@@ -21,7 +21,7 @@ __all__ = [
     "Dataset", "Booster", "CVBooster", "LightGBMError",
     "train", "cv",
     "early_stopping", "log_evaluation", "record_evaluation",
-    "reset_parameter", "EarlyStopException",
+    "reset_parameter", "telemetry", "EarlyStopException",
     "register_logger", "Config",
 ]
 

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import io
 import os
+import threading
 import warnings
 from pathlib import Path
 from typing import Any, Dict, List, Optional, Tuple, Union
@@ -368,6 +369,8 @@ class Dataset:
     """Binned training data container (Dataset + Metadata + DatasetLoader
     analog: dataset.h:48-555, dataset_loader.cpp)."""
 
+    _construct_tl = threading.local()
+
     def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
                  weight=None, group=None, init_score=None,
                  feature_name: Union[str, List[str]] = "auto",
@@ -572,6 +575,21 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._handle is not None:
             return self
+        # time only the OUTERMOST construct: an unconstructed
+        # `reference` chain re-enters here and would double-count the
+        # inner duration under the same label
+        tl = Dataset._construct_tl
+        if getattr(tl, "depth", 0):
+            return self._construct_impl()
+        from .utils.timer import timed
+        tl.depth = 1
+        try:
+            with timed("dataset/construct"):
+                return self._construct_impl()
+        finally:
+            tl.depth = 0
+
+    def _construct_impl(self) -> "Dataset":
         cfg = Config.from_params(self.params)
         data = self.data
         label = self.label
@@ -1166,21 +1184,24 @@ class Booster:
             if not isinstance(train_set, Dataset):
                 raise TypeError("Training data should be a Dataset instance")
             cfg = Config.from_params(params)
-            train_set.params = {**resolve_params(train_set.params),
-                               **resolve_params(params)}
-            train_set.construct()
-            self._cfg = cfg
-            objective = create_objective(cfg)
-            if objective is not None and hasattr(objective, "set_dataset"):
-                objective.set_dataset(train_set)
-            from .models.gbdt import GBDTBooster
-            self._engine = GBDTBooster(cfg, train_set, objective)
-            self._metrics = create_metrics(cfg)
-            self._num_class = cfg.num_class
-            self._feature_names = train_set.get_feature_name()
-            self._feature_infos = train_set.feature_infos()
-            self._objective_str = self._objective_repr(cfg)
-            self._avg_output = cfg.boosting == "rf"
+            from .utils.log import scoped_verbosity
+            with scoped_verbosity(cfg.verbosity):
+                train_set.params = {**resolve_params(train_set.params),
+                                    **resolve_params(params)}
+                train_set.construct()
+                self._cfg = cfg
+                objective = create_objective(cfg)
+                if objective is not None and hasattr(objective,
+                                                     "set_dataset"):
+                    objective.set_dataset(train_set)
+                from .models.gbdt import GBDTBooster
+                self._engine = GBDTBooster(cfg, train_set, objective)
+                self._metrics = create_metrics(cfg)
+                self._num_class = cfg.num_class
+                self._feature_names = train_set.get_feature_name()
+                self._feature_infos = train_set.feature_infos()
+                self._objective_str = self._objective_repr(cfg)
+                self._avg_output = cfg.boosting == "rf"
             self.train_set = train_set
         elif model_file is not None:
             with open(model_file) as f:

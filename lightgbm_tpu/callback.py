@@ -21,7 +21,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 from .utils.log import log_info, log_warning
 
 __all__ = ["EarlyStopException", "CallbackEnv", "log_evaluation",
-           "record_evaluation", "reset_parameter", "early_stopping"]
+           "record_evaluation", "reset_parameter", "early_stopping",
+           "telemetry"]
 
 
 class EarlyStopException(Exception):
@@ -252,3 +253,40 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
     return _EarlyStopping(stopping_rounds=stopping_rounds,
                           first_metric_only=first_metric_only,
                           verbose=verbose, min_delta=min_delta)
+
+
+@dataclass(eq=False)
+class _Telemetry:
+    """Stream one JSONL telemetry event per iteration (obs/recorder.py).
+
+    Runs after evaluation/logging (order 40) so the event carries the
+    iteration's eval results. The train loop calls ``attach`` before the
+    first iteration and ``finish`` on exit (including the early-stop
+    unwind, where an after-callback raising means this one may never
+    fire for the final iteration).
+    """
+    recorder: Any
+    order: int = 40
+    before_iteration: bool = False
+
+    def attach(self, model) -> None:
+        self.recorder.attach(model)
+
+    def finish(self) -> None:
+        self.recorder.close()
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if env.model is not None:
+            self.recorder.attach(env.model)
+        self.recorder.record_iteration(env.iteration,
+                                       env.evaluation_result_list)
+
+
+def telemetry(path: str, registry=None) -> Callable:
+    """Record per-iteration run telemetry to ``path`` (JSONL).
+
+    Equivalent to setting ``LIGHTGBM_TPU_TELEMETRY=<path>``; summarize
+    the output with ``python -m lightgbm_tpu stats <path>``.
+    """
+    from .obs import TelemetryRecorder
+    return _Telemetry(TelemetryRecorder(path, registry=registry))

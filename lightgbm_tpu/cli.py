@@ -8,6 +8,7 @@ train / predict / convert_model / refit / save_binary.
 Usage:
     python -m lightgbm_tpu config=train.conf [key=value ...]
     python -m lightgbm_tpu task=train data=train.csv objective=binary
+    python -m lightgbm_tpu stats run.jsonl     # summarize telemetry
 
 Config-file syntax matches the reference (application.cpp:50-86 +
 config.cpp KV2Map): one ``key = value`` per line, ``#`` comments;
@@ -170,6 +171,34 @@ def _task_save_binary(cfg: Config, params: Dict[str, Any]) -> None:
     log_info(f"Binned dataset saved to {out}")
 
 
+def _task_stats(argv: List[str]) -> int:
+    """``lightgbm_tpu stats <file.jsonl>``: fold a telemetry event
+    stream (callback.telemetry / LIGHTGBM_TPU_TELEMETRY) into the
+    sorted per-phase summary table."""
+    if not argv:
+        print("usage: python -m lightgbm_tpu stats <file.jsonl>",
+              file=sys.stderr)
+        return 1
+    from .obs import render_stats_table, summarize_events
+    path = argv[0]
+    try:
+        summary = summarize_events(path)
+    except OSError as e:
+        print(f"[LightGBM-TPU] [Fatal] cannot read {path}: {e}",
+              file=sys.stderr)
+        return 1
+    except (ValueError, TypeError, AttributeError, KeyError) as e:
+        # malformed JSON line or structurally-wrong event object
+        print(f"[LightGBM-TPU] [Fatal] malformed telemetry in {path}: "
+              f"{e}", file=sys.stderr)
+        return 1
+    if summary["iterations"] == 0:
+        print(f"no iteration events in {path}", file=sys.stderr)
+        return 1
+    print(render_stats_table(summary))
+    return 0
+
+
 _TASKS = {
     "train": _task_train,
     "refit": _task_refit,
@@ -187,6 +216,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not argv or argv in (["-h"], ["--help"]):
         print(__doc__)
         return 0
+    if argv[0] == "stats":
+        return _task_stats(argv[1:])
     try:
         params = parse_args(argv)
         cfg = Config.from_params(params)

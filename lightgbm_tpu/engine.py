@@ -9,6 +9,7 @@ bookkeeping match the reference semantics.
 from __future__ import annotations
 
 import copy
+import os
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
@@ -17,7 +18,28 @@ import numpy as np
 from . import callback as callback_mod
 from .basic import Booster, Dataset, LightGBMError
 from .config import Config, resolve_params
-from .utils.log import log_info, log_warning
+from .utils.log import log_info, log_warning, scoped_verbosity
+from .utils.timer import Timer, timed
+
+
+def _setup_telemetry(callbacks: List[Callable], model) -> None:
+    """Activate run telemetry: honor ``LIGHTGBM_TPU_TELEMETRY=<path>``
+    unless a telemetry callback is already present, then bind every
+    recorder-bearing callback to the model before the first iteration
+    (so iteration 0's event already carries tree stats)."""
+    telem_path = os.environ.get("LIGHTGBM_TPU_TELEMETRY")
+    if telem_path and not any(isinstance(cb, callback_mod._Telemetry)
+                              for cb in callbacks):
+        callbacks.append(callback_mod.telemetry(telem_path))
+    for cb in callbacks:
+        if isinstance(cb, callback_mod._Telemetry):
+            cb.attach(model)
+
+
+def _finish_callbacks(callbacks: List[Callable]) -> None:
+    for cb in callbacks:
+        if isinstance(cb, callback_mod._Telemetry):
+            cb.finish()
 
 __all__ = ["train", "cv", "CVBooster"]
 
@@ -38,6 +60,16 @@ def train(params: Dict[str, Any], train_set: Dataset,
         num_boost_round = int(params["num_iterations"])
     params["num_iterations"] = num_boost_round
     cfg = Config.from_params(params)
+    with scoped_verbosity(cfg.verbosity):
+        return _train_impl(params, cfg, train_set, num_boost_round,
+                           valid_sets, valid_names, feval, init_model,
+                           keep_training_booster, callbacks, fobj)
+
+
+def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
+                num_boost_round: int, valid_sets, valid_names, feval,
+                init_model, keep_training_booster, callbacks,
+                fobj) -> Booster:
     if cfg.objective == "custom" and fobj is None:
         raise LightGBMError(
             "objective=none requires a custom objective function (fobj)")
@@ -84,6 +116,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             verbose=cfg.verbosity >= 1))
     if cfg.verbosity >= 1 and cfg.is_provide_training_metric:
         pass  # training metric printed through evaluation list below
+    _setup_telemetry(callbacks, booster)
     cbs_before = {cb for cb in callbacks
                   if getattr(cb, "before_iteration", False)}
     cbs_after = [cb for cb in callbacks if cb not in cbs_before]
@@ -92,44 +125,49 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     begin_iteration = 0
     evaluation_result_list: List[Tuple] = []
-    for i in range(begin_iteration, begin_iteration + num_boost_round):
-        for cb in cbs_before:
-            cb(callback_mod.CallbackEnv(
-                model=booster, params=params, iteration=i,
-                begin_iteration=begin_iteration,
-                end_iteration=begin_iteration + num_boost_round,
-                evaluation_result_list=None))
-        finished = booster.update(fobj=fobj)
-
-        evaluation_result_list = []
-        if (i + 1) % max(1, cfg.metric_freq) == 0 or \
-                i == begin_iteration + num_boost_round - 1:
-            if valid_sets or is_valid_contain_train:
-                if is_valid_contain_train:
-                    evaluation_result_list.extend(booster.eval_train(feval))
-                evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in cbs_after:
+    try:
+        for i in range(begin_iteration, begin_iteration + num_boost_round):
+            for cb in cbs_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
                     begin_iteration=begin_iteration,
                     end_iteration=begin_iteration + num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
-        except callback_mod.EarlyStopException as es:
-            booster.best_iteration = es.best_iteration + 1
-            evaluation_result_list = es.best_score
-            # roll the model back to best_iteration for storage parity
-            break
-        if finished:
-            log_info("Stopped training because there are no more leaves "
-                     "that meet the split requirements")
-            break
+                    evaluation_result_list=None))
+            finished = booster.update(fobj=fobj)
+
+            evaluation_result_list = []
+            if (i + 1) % max(1, cfg.metric_freq) == 0 or \
+                    i == begin_iteration + num_boost_round - 1:
+                if valid_sets or is_valid_contain_train:
+                    with timed("engine/eval"):
+                        if is_valid_contain_train:
+                            evaluation_result_list.extend(
+                                booster.eval_train(feval))
+                        evaluation_result_list.extend(
+                            booster.eval_valid(feval))
+            try:
+                for cb in cbs_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=begin_iteration,
+                        end_iteration=begin_iteration + num_boost_round,
+                        evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as es:
+                booster.best_iteration = es.best_iteration + 1
+                evaluation_result_list = es.best_score
+                # roll the model back to best_iteration for storage parity
+                break
+            if finished:
+                log_info("Stopped training because there are no more "
+                         "leaves that meet the split requirements")
+                break
+    finally:
+        _finish_callbacks(callbacks)
 
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration()
     for item in (evaluation_result_list or []):
         booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
-    from .utils.timer import Timer
     if Timer.enabled():
         Timer.log_summary()
     return booster
@@ -241,6 +279,16 @@ def cv(params: Dict[str, Any], train_set: Dataset,
     if metrics is not None:
         params["metric"] = metrics
     cfg = Config.from_params(params)
+    with scoped_verbosity(cfg.verbosity):
+        return _cv_impl(params, cfg, train_set, num_boost_round, folds,
+                        nfold, stratified, shuffle, feval, fpreproc, seed,
+                        callbacks, eval_train_metric, return_cvbooster)
+
+
+def _cv_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
+             num_boost_round: int, folds, nfold, stratified, shuffle,
+             feval, fpreproc, seed, callbacks, eval_train_metric,
+             return_cvbooster) -> Dict[str, Any]:
     if cfg.objective in ("binary", "multiclass", "multiclassova",
                          "lambdarank", "rank_xendcg"):
         stratified = stratified and cfg.objective == "binary"
@@ -278,6 +326,7 @@ def cv(params: Dict[str, Any], train_set: Dataset,
             cfg.early_stopping_round,
             first_metric_only=cfg.first_metric_only,
             verbose=cfg.verbosity >= 1))
+    _setup_telemetry(callbacks, cvbooster)
     cbs_before = sorted((cb for cb in callbacks
                          if getattr(cb, "before_iteration", False)),
                         key=lambda c: getattr(c, "order", 0))
@@ -285,38 +334,42 @@ def cv(params: Dict[str, Any], train_set: Dataset,
                         if not getattr(cb, "before_iteration", False)),
                        key=lambda c: getattr(c, "order", 0))
 
-    for i in range(num_boost_round):
-        for cb in cbs_before:
-            cb(callback_mod.CallbackEnv(
-                model=cvbooster, params=params, iteration=i,
-                begin_iteration=0, end_iteration=num_boost_round,
-                evaluation_result_list=None))
-        for booster in boosters:
-            booster.update()
-        raw = []
-        for booster in boosters:
-            one = []
-            if eval_train_metric:
-                one.extend(booster.eval_train(feval))
-            one.extend(booster.eval_valid(feval))
-            raw.append(one)
-        res = _agg_cv_result(raw)
-        for (_, key, mean, _, std) in res:
-            results.setdefault(f"{key}-mean", []).append(mean)
-            results.setdefault(f"{key}-stdv", []).append(std)
-        try:
-            for cb in cbs_after:
+    try:
+        for i in range(num_boost_round):
+            for cb in cbs_before:
                 cb(callback_mod.CallbackEnv(
                     model=cvbooster, params=params, iteration=i,
                     begin_iteration=0, end_iteration=num_boost_round,
-                    evaluation_result_list=res))
-        except callback_mod.EarlyStopException as es:
-            cvbooster.best_iteration = es.best_iteration + 1
-            for bst in boosters:
-                bst.best_iteration = cvbooster.best_iteration
-            for k in results:
-                results[k] = results[k][: cvbooster.best_iteration]
-            break
+                    evaluation_result_list=None))
+            for booster in boosters:
+                booster.update()
+            raw = []
+            with timed("engine/eval"):
+                for booster in boosters:
+                    one = []
+                    if eval_train_metric:
+                        one.extend(booster.eval_train(feval))
+                    one.extend(booster.eval_valid(feval))
+                    raw.append(one)
+            res = _agg_cv_result(raw)
+            for (_, key, mean, _, std) in res:
+                results.setdefault(f"{key}-mean", []).append(mean)
+                results.setdefault(f"{key}-stdv", []).append(std)
+            try:
+                for cb in cbs_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=cvbooster, params=params, iteration=i,
+                        begin_iteration=0, end_iteration=num_boost_round,
+                        evaluation_result_list=res))
+            except callback_mod.EarlyStopException as es:
+                cvbooster.best_iteration = es.best_iteration + 1
+                for bst in boosters:
+                    bst.best_iteration = cvbooster.best_iteration
+                for k in results:
+                    results[k] = results[k][: cvbooster.best_iteration]
+                break
+    finally:
+        _finish_callbacks(callbacks)
 
     if return_cvbooster:
         results["cvbooster"] = cvbooster

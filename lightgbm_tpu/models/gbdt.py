@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import Config
+from ..obs import register_jit
 from ..objectives import Objective
 from ..ops.gather import gather_small
 from ..ops.grow import GrowConfig, TreeArrays, grow_tree
@@ -67,6 +68,13 @@ def _linear_eval(const, coef, feats, nfeat, leaf_value, raw, leaves):
     from ..ops.linear import linear_leaf_values
     return linear_leaf_values(const, coef, feats, nfeat, leaf_value, raw,
                               leaves)
+
+
+# recompile telemetry (obs/jit_tracker.py): a cache miss on any of these
+# mid-training is the 530 ms/iter regression class from PROFILE.md
+register_jit("gbdt/tree_values_binned", _tree_values_binned)
+register_jit("gbdt/tree_leaves_binned", _tree_leaves_binned)
+register_jit("gbdt/linear_eval", _linear_eval)
 
 
 class _ValidData:
@@ -351,14 +359,14 @@ class GBDTBooster:
         from ..parallel.data_parallel import make_dp_grow_fn
 
         cfg = self.cfg
-        return make_dp_grow_fn(
+        return register_jit("parallel/dp_grow", make_dp_grow_fn(
             self.grow_cfg, self.mesh, self.monotone is not None,
             self.feat_is_cat is not None,
             cfg.use_quantized_grad and cfg.stochastic_rounding,
             self.interaction_groups is not None,
             self.forced is not None,
             self.grow_cfg.bynode < 1.0,
-            has_bundle=self.bundle is not None)
+            has_bundle=self.bundle is not None))
 
     def _init_keys_and_rngs(self, cfg):
         # distinct stream for per-node column sampling (ColSampler's
@@ -404,6 +412,34 @@ class GBDTBooster:
                     tree.leaf_value = tree.leaf_value + bias
                     tree.internal_value = tree.internal_value + bias
             self._models_store.append(tree)
+
+    def telemetry_tree_stats(self) -> Optional[Dict[str, float]]:
+        """Leaves grown + split-gain sum of the LAST iteration's trees,
+        for the telemetry recorder (obs/recorder.py). Reads the pending
+        async device copies when trees are deferred — a small host fetch
+        that only happens with telemetry active; the hot path never
+        calls this. Returns None before the first iteration."""
+        if self.iter_ <= 0:
+            return None
+        K = self.K
+        leaves = 0
+        gain = 0.0
+        if len(self._pending_dev) >= K:
+            for vec, cmask, proto, _, _ in self._pending_dev[-K:]:
+                host = unpack_tree_host(np.asarray(vec), cmask, proto)
+                nl = int(host.num_leaves)
+                leaves += nl
+                gain += float(np.sum(
+                    np.asarray(host.split_gain)[: max(nl - 1, 0)]))
+        elif len(self._models_store) >= K:
+            for tree in self._models_store[-K:]:
+                nl = int(tree.num_leaves)
+                leaves += nl
+                gain += float(np.sum(
+                    np.asarray(tree.split_gain)[: max(nl - 1, 0)]))
+        else:
+            return None
+        return {"trees": K, "leaves": leaves, "split_gain_sum": gain}
 
     def preload_models(self, trees: List[Tree]) -> None:
         """Continue training from an existing model (the reference's
@@ -874,7 +910,8 @@ class GBDTBooster:
         # donate the old score buffer (it is consumed) — except on CPU,
         # where XLA ignores donation and warns
         donate = () if jax.default_backend() == "cpu" else (0,)
-        self._fused_fn = jax.jit(step, donate_argnums=donate)
+        self._fused_fn = register_jit("gbdt/fused_iter",
+                                      jax.jit(step, donate_argnums=donate))
         return self._fused_fn
 
     def _train_one_iter_fused(self) -> bool:

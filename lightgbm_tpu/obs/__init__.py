@@ -1,0 +1,38 @@
+"""Run telemetry: metrics registry, recompile/HBM tracking, JSONL events.
+
+The observability spine the perf ROADMAP items report against. Round 5's
+PROFILE.md lesson is that per-op microbenchmarks lie in both directions
+on this codebase — only in-situ measurement of the real boosting loop is
+trustworthy — so every layer here instruments the *actual* hot path and
+is a strict no-op when disabled:
+
+- :class:`MetricsRegistry` — label-keyed, thread-safe counters / gauges /
+  histograms (`registry` is the process-global instance).
+- :mod:`~lightgbm_tpu.obs.jit_tracker` — registered jitted entry points
+  (grow / fused-iteration / predict) expose XLA cache-size deltas, so a
+  shape-change recompile shows up as a counted event, not a mystery
+  530 ms stall.
+- :func:`device_memory_stats` — HBM gauges via ``device.memory_stats()``
+  with explicit ``None`` on backends that lack it (CPU).
+- :class:`TelemetryRecorder` — one JSONL event per boosting iteration
+  (phase wall times, recompiles, HBM, tree stats, eval results),
+  activated by ``lightgbm_tpu.callback.telemetry(path)`` or the
+  ``LIGHTGBM_TPU_TELEMETRY=<path>`` env var.
+
+See docs/OBSERVABILITY.md for the event schema and workflow.
+"""
+
+from .jit_tracker import (RecompileWatcher, jit_cache_sizes, register_jit,
+                          total_recompiles)
+from .memory import device_memory_stats
+from .recorder import (ITERATION_EVENT_KEYS, TelemetryRecorder,
+                       render_stats_table, summarize_events)
+from .registry import Counter, Gauge, Histogram, MetricsRegistry, registry
+
+__all__ = [
+    "MetricsRegistry", "Counter", "Gauge", "Histogram", "registry",
+    "register_jit", "jit_cache_sizes", "total_recompiles",
+    "RecompileWatcher", "device_memory_stats",
+    "TelemetryRecorder", "ITERATION_EVENT_KEYS",
+    "summarize_events", "render_stats_table",
+]

@@ -1,0 +1,105 @@
+"""Recompile tracking for jitted hot-path entry points.
+
+A silent XLA recompile is the single most expensive event this codebase
+can hit mid-training (PROFILE.md's 530 ms/iter regression class), and it
+never announces itself. Every jitted boosting-path entry point registers
+here (``register_jit``); the per-function compile-cache size
+(``PjitFunction._cache_size``) is then a direct compile counter — a
+cache miss IS a compilation — and :class:`RecompileWatcher` turns the
+sizes into per-interval deltas for the JSONL event stream.
+
+Registration keys on ``(name, seq)`` with a monotonic sequence number:
+rebuilding an entry point (the fused step is re-jitted after
+``reset_parameter``; cv builds one per fold) registers a NEW key whose
+whole cache size counts as fresh compiles, so replacement never hides
+work behind a shrinking counter — and a recycled object address
+(``id()`` reuse after GC) can never alias a new function onto a dead
+entry.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Callable, Dict, Tuple
+
+__all__ = ["register_jit", "jit_cache_sizes", "total_recompiles",
+           "RecompileWatcher"]
+
+_lock = threading.Lock()
+# (name, seq) -> weakref to the jitted callable; weak so per-booster
+# fused functions don't outlive their engine
+_tracked: Dict[Tuple[str, int], "weakref.ref"] = {}
+_seq = 0
+
+
+def register_jit(name: str, fn: Callable) -> Callable:
+    """Track a jitted callable's compile cache; returns ``fn`` so it can
+    wrap a definition site. Non-jitted callables (no ``_cache_size``)
+    are accepted and ignored — callers never need to branch.
+    Re-registering the same live object under the same name is a
+    no-op."""
+    global _seq
+    if not hasattr(fn, "_cache_size"):
+        return fn
+    try:
+        ref = weakref.ref(fn)
+    except TypeError:  # not weakref-able; keep a strong closure
+        ref = (lambda f: (lambda: f))(fn)
+    with _lock:
+        for (tracked_name, _), r in _tracked.items():
+            if tracked_name == name and r() is fn:
+                return fn
+        _seq += 1
+        _tracked[(name, _seq)] = ref
+    return fn
+
+
+def jit_cache_sizes() -> Dict[Tuple[str, int], int]:
+    """Current compile-cache size per live tracked function."""
+    out: Dict[Tuple[str, int], int] = {}
+    dead = []
+    with _lock:
+        items = list(_tracked.items())
+    for key, ref in items:
+        fn = ref()
+        if fn is None:
+            dead.append(key)
+            continue
+        try:
+            out[key] = int(fn._cache_size())
+        except Exception:
+            out[key] = 0
+    if dead:
+        with _lock:
+            for key in dead:
+                _tracked.pop(key, None)
+    return out
+
+
+def total_recompiles() -> int:
+    """Total compilations across all live tracked entry points."""
+    return sum(jit_cache_sizes().values())
+
+
+class RecompileWatcher:
+    """Delta view over the tracked cache sizes.
+
+    ``delta()`` returns compilations since the previous ``delta()`` (or
+    construction): new keys contribute their full size, grown keys the
+    growth. A function garbage-collected between calls simply drops out;
+    its past compiles were already reported.
+    """
+
+    def __init__(self):
+        self._last = jit_cache_sizes()
+        self.total = 0
+
+    def delta(self) -> int:
+        now = jit_cache_sizes()
+        d = 0
+        for key, size in now.items():
+            d += max(0, size - self._last.get(key, 0))
+        self._last = now
+        self.total += d
+        return d

@@ -1,0 +1,309 @@
+"""Per-iteration JSONL telemetry events + the stats summarizer.
+
+One :class:`TelemetryRecorder` owns one output file and emits exactly
+one JSON object per boosting iteration, carrying:
+
+- ``phases``: per-label wall-time deltas for the iteration (diffed from
+  ``Timer.snapshot()``; under multi-process SPMD each phase carries
+  min/max/mean across processes so chip skew is visible),
+- ``recompiles``: jit cache-miss count this iteration plus the running
+  total (see :mod:`~lightgbm_tpu.obs.jit_tracker`),
+- ``hbm``: ``device.memory_stats()`` gauges, explicit nulls on CPU,
+- ``tree``: leaves grown and split-gain sum of the iteration's trees,
+- ``eval``: the evaluation tuples the train loop produced (if any).
+
+The recorder is inert until ``attach()`` (called by the train loop once
+a telemetry callback or ``LIGHTGBM_TPU_TELEMETRY`` is present): no file
+is opened, the Timer stays untouched, and a disabled run writes zero
+bytes. Everything it measures also feeds the global
+:class:`~lightgbm_tpu.obs.registry.MetricsRegistry`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+from .jit_tracker import RecompileWatcher
+from .memory import device_memory_stats
+from .registry import MetricsRegistry
+from .registry import registry as _global_registry
+
+__all__ = ["TelemetryRecorder", "ITERATION_EVENT_KEYS",
+           "summarize_events", "render_stats_table"]
+
+#: required keys of every iteration event (the JSONL schema contract)
+ITERATION_EVENT_KEYS = ("event", "iteration", "wall_time", "phases",
+                        "recompiles", "hbm", "tree", "eval")
+
+
+class TelemetryRecorder:
+    """Streams one JSONL event per boosting iteration to ``path``."""
+
+    def __init__(self, path: str,
+                 registry: Optional[MetricsRegistry] = None):
+        self.path = str(path)
+        self.registry = registry if registry is not None \
+            else _global_registry
+        self._file = None
+        self._started = False
+        self._engines: List = []
+        self._watcher: Optional[RecompileWatcher] = None
+        self._phase_base: Dict[str, Dict[str, float]] = {}
+        self._prev_timer_enabled: Optional[bool] = None
+        self._t0 = 0.0
+        self.events_written = 0
+
+    # -- lifecycle -----------------------------------------------------
+    @property
+    def active(self) -> bool:
+        return self._started
+
+    def attach(self, model) -> None:
+        """Bind to a Booster / CVBooster and start recording. Idempotent
+        per recorder; a recorder reused across train() calls keeps
+        appending to the same file. Under multi-process SPMD every
+        process records (the phase aggregation is a collective all ranks
+        must join) but only process 0 writes the file — ranks would
+        otherwise clobber a shared path."""
+        engines = []
+        for booster in getattr(model, "boosters", None) or [model]:
+            eng = getattr(booster, "_engine", None)
+            if eng is not None and eng not in engines:
+                engines.append(eng)
+        self._engines = engines
+        if self._started:
+            return
+        from ..utils.timer import Timer
+        self._prev_timer_enabled = Timer.enabled()
+        Timer.enable()
+        self._phase_base = Timer.snapshot()
+        self._watcher = RecompileWatcher()
+        self._t0 = time.perf_counter()
+        self._started = True
+        try:
+            import jax
+            is_writer = jax.process_index() == 0
+        except Exception:
+            is_writer = True
+        if is_writer:
+            # telemetry must degrade, never break training: an
+            # unwritable path (read-only CI mount via the env var, full
+            # disk) downgrades to registry-only recording
+            try:
+                dirname = os.path.dirname(os.path.abspath(self.path))
+                os.makedirs(dirname, exist_ok=True)
+                self._file = open(self.path, "a", encoding="utf-8")
+            except OSError as e:
+                from ..utils.log import log_warning
+                log_warning(f"telemetry: cannot open {self.path!r} "
+                            f"({e}); events will not be written")
+                self._file = None
+
+    def close(self) -> None:
+        """Flush and restore the Timer to its pre-attach state."""
+        if self._file is not None:
+            self._file.close()
+            self._file = None
+        if self._prev_timer_enabled is not None:
+            from ..utils.timer import Timer
+            Timer.enable(self._prev_timer_enabled)
+            self._prev_timer_enabled = None
+        self._started = False
+        self._engines = []
+
+    # -- event assembly ------------------------------------------------
+    def _phase_delta(self, keep_all: bool = False) \
+            -> Dict[str, Dict[str, float]]:
+        """Per-iteration diff of ``Timer.snapshot()``. ``keep_all``
+        retains zero-delta labels — required under multi-process SPMD so
+        every rank enters the phase allgather with the same label set
+        even on iterations where a phase (e.g. eval) ran on none."""
+        from ..utils.timer import Timer
+        snap = Timer.snapshot()
+        delta: Dict[str, Dict[str, float]] = {}
+        for label, cur in snap.items():
+            base = self._phase_base.get(label, {"total": 0.0, "count": 0})
+            dt = cur["total"] - base["total"]
+            dc = int(cur["count"] - base["count"])
+            if keep_all or dc > 0 or dt > 0:
+                delta[label] = {"total": dt, "count": dc}
+        self._phase_base = snap
+        return delta
+
+    def _tree_stats(self) -> Dict[str, Optional[float]]:
+        leaves = 0
+        gain = 0.0
+        trees = 0
+        for eng in self._engines:
+            stats = None
+            getter = getattr(eng, "telemetry_tree_stats", None)
+            if getter is not None:
+                stats = getter()
+            if stats is None:
+                continue
+            trees += stats["trees"]
+            leaves += stats["leaves"]
+            gain += stats["split_gain_sum"]
+        if trees == 0:
+            return {"trees": 0, "leaves": None, "split_gain_sum": None}
+        return {"trees": trees, "leaves": leaves, "split_gain_sum": gain}
+
+    @staticmethod
+    def _eval_dict(evals: Optional[Sequence]) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for entry in evals or []:
+            try:
+                out[f"{entry[0]}:{entry[1]}"] = float(entry[2])
+            except (TypeError, ValueError, IndexError):
+                continue
+        return out
+
+    def record_iteration(self, iteration: int,
+                         evals: Optional[Sequence] = None) -> dict:
+        """Assemble, register and write the event for one iteration."""
+        if not self.active:
+            return {}
+        try:
+            import jax
+            multiproc = jax.process_count() > 1
+        except Exception:
+            multiproc = False
+        phases = self._phase_delta(keep_all=multiproc)
+        if multiproc:
+            from ..parallel.spmd import aggregate_phase_snapshot
+            phases = aggregate_phase_snapshot(phases)
+        recompile_delta = self._watcher.delta()
+        hbm = device_memory_stats()
+        tree = self._tree_stats()
+        event = {
+            "event": "iteration",
+            "iteration": int(iteration),
+            "wall_time": time.perf_counter() - self._t0,
+            "phases": phases,
+            "recompiles": {"delta": recompile_delta,
+                           "total": self._watcher.total},
+            "hbm": hbm,
+            "tree": tree,
+            "eval": self._eval_dict(evals),
+        }
+        self._feed_registry(event)
+        if self._file is not None:
+            try:
+                self._file.write(json.dumps(event) + "\n")
+                self._file.flush()
+            except OSError as e:  # ENOSPC etc. — degrade, keep training
+                from ..utils.log import log_warning
+                log_warning(f"telemetry: write to {self.path!r} failed "
+                            f"({e}); stopping the event stream")
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
+        self.events_written += 1
+        return event
+
+    def _feed_registry(self, event: dict) -> None:
+        reg = self.registry
+        reg.counter("iterations").inc()
+        reg.counter("jit_recompiles").inc(event["recompiles"]["delta"])
+        for label, v in event["phases"].items():
+            reg.histogram("phase_seconds", phase=label).observe(
+                v.get("total", v.get("mean", 0.0)))
+        for key in ("bytes_in_use", "peak_bytes_in_use"):
+            if event["hbm"].get(key) is not None:
+                reg.gauge(f"hbm_{key}").set(event["hbm"][key])
+        if event["tree"]["leaves"] is not None:
+            reg.histogram("tree_leaves").observe(event["tree"]["leaves"])
+            reg.histogram("tree_split_gain_sum").observe(
+                event["tree"]["split_gain_sum"])
+
+
+# ---------------------------------------------------------------------
+# summary side: consumed by `lightgbm_tpu stats <file.jsonl>` and bench
+# ---------------------------------------------------------------------
+
+def summarize_events(path: str) -> dict:
+    """Fold a telemetry JSONL file into one summary dict."""
+    iters = 0
+    phases: Dict[str, Dict[str, float]] = {}
+    recompiles = 0
+    peak_hbm: Optional[int] = None
+    leaves = 0
+    gain = 0.0
+    wall = 0.0
+    last_eval: Dict[str, float] = {}
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            ev = json.loads(line)
+            if not isinstance(ev, dict):
+                raise ValueError(
+                    f"telemetry line is not a JSON object: {line[:80]!r}")
+            if ev.get("event") != "iteration":
+                continue
+            iters += 1
+            wall = max(wall, float(ev.get("wall_time", 0.0)))
+            for label, v in ev.get("phases", {}).items():
+                slot = phases.setdefault(
+                    label, {"total": 0.0, "count": 0,
+                            "max_skew": 0.0})
+                # single-process events carry total; SPMD-aggregated
+                # ones carry mean (per-process) + min/max
+                slot["total"] += float(v.get("total", v.get("mean", 0.0)))
+                slot["count"] += int(v.get("count", 0))
+                if "max" in v and "min" in v:
+                    slot["max_skew"] = max(
+                        slot["max_skew"],
+                        float(v["max"]) - float(v["min"]))
+            recompiles += int(ev.get("recompiles", {}).get("delta", 0))
+            hbm = ev.get("hbm", {})
+            for key in ("peak_bytes_in_use", "bytes_in_use"):
+                if hbm.get(key) is not None:
+                    peak_hbm = max(peak_hbm or 0, int(hbm[key]))
+                    break
+            tree = ev.get("tree", {})
+            if tree.get("leaves") is not None:
+                leaves += int(tree["leaves"])
+                gain += float(tree.get("split_gain_sum") or 0.0)
+            if ev.get("eval"):
+                last_eval = ev["eval"]
+    return {"iterations": iters, "wall_time": wall, "phases": phases,
+            "recompiles": recompiles, "peak_hbm_bytes": peak_hbm,
+            "total_leaves": leaves, "total_split_gain": gain,
+            "last_eval": last_eval}
+
+
+def render_stats_table(summary: dict) -> str:
+    """The sorted human-readable table behind ``lightgbm_tpu stats``."""
+    lines = []
+    lines.append(f"iterations           : {summary['iterations']}")
+    lines.append(f"wall time            : {summary['wall_time']:.3f} s")
+    lines.append(f"jit recompiles       : {summary['recompiles']}")
+    hbm = summary["peak_hbm_bytes"]
+    lines.append("peak HBM             : " +
+                 (f"{hbm / 2**20:.1f} MiB" if hbm is not None else "n/a"))
+    lines.append(f"leaves grown         : {summary['total_leaves']}")
+    lines.append(f"split gain sum       : {summary['total_split_gain']:g}")
+    for key, val in sorted(summary["last_eval"].items()):
+        lines.append(f"final {key:15s}: {val:g}")
+    phases = summary["phases"]
+    if phases:
+        grand = sum(v["total"] for v in phases.values()) or 1.0
+        lines.append("")
+        lines.append(f"{'phase':34s} {'total s':>10s} {'count':>8s} "
+                     f"{'mean ms':>10s} {'%':>6s} {'skew s':>8s}")
+        for label, v in sorted(phases.items(),
+                               key=lambda kv: -kv[1]["total"]):
+            cnt = int(v["count"])
+            mean_ms = v["total"] / cnt * 1e3 if cnt else 0.0
+            lines.append(
+                f"{label:34s} {v['total']:10.3f} {cnt:8d} "
+                f"{mean_ms:10.3f} {100 * v['total'] / grand:6.1f} "
+                f"{v['max_skew']:8.3f}")
+    return "\n".join(lines)

@@ -41,6 +41,15 @@ from .partition_kernel import route_concentrate
 
 __all__ = ["GrowConfig", "TreeArrays", "grow_tree", "route_concentrate"]
 
+
+def _axis_size(name) -> int:
+    """Static mapped-axis size. ``lax.axis_size`` only exists on
+    jax>=0.4.38; 0.4.37's accessor is ``core.axis_frame`` (returns the
+    int size under shard_map)."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(name)
+    return jax.core.axis_frame(name)
+
 NEG_INF = -jnp.inf
 
 class GrowConfig(NamedTuple):
@@ -883,7 +892,7 @@ def _grow_compact_impl(cfg: GrowConfig,
             # leaf sums and shard-scaled data constraints (the
             # reference's local_config_, voting_parallel_tree_learner
             # .cpp:61-63)
-            ndev = lax.axis_size(ax)
+            ndev = _axis_size(ax)
             lh_tot = jnp.sum(hist[0], axis=0)   # feature 0 sees all rows
             sg_loc, sh_loc = lh_tot[0], lh_tot[1]
             sc_loc = jnp.round(sc * sh_loc / jnp.maximum(sh, 1e-15))
@@ -1154,7 +1163,7 @@ def _grow_compact_impl(cfg: GrowConfig,
     # device min(f // Fl, D-1) only — each device's search mask keeps
     # just its owned columns, so hist rows and metadata stay aligned.
     if fp:
-        D_fp = lax.axis_size(cfg.axis_name)       # static under shard_map
+        D_fp = _axis_size(cfg.axis_name)          # static under shard_map
         dev_idx = lax.axis_index(cfg.axis_name)   # traced
         NWl = -(-NW // D_fp)
         Fl = NWl * pack_w
@@ -2357,3 +2366,9 @@ def _grow_compact_impl(cfg: GrowConfig,
 
 
 grow_tree = jax.jit(grow_tree_impl, static_argnames=("cfg",))
+
+# recompile telemetry: growth is the hot path whose silent recompiles
+# telemetry exists to catch (obs/jit_tracker.py)
+from ..obs import register_jit  # noqa: E402  (after grow_tree exists)
+
+register_jit("ops/grow_tree", grow_tree)

@@ -28,7 +28,45 @@ from typing import List, Optional
 
 import numpy as np
 
-__all__ = ["sync_bin_mappers", "distributed_dataset"]
+__all__ = ["sync_bin_mappers", "distributed_dataset",
+           "aggregate_phase_snapshot"]
+
+
+def aggregate_phase_snapshot(snap: dict) -> dict:
+    """Cross-host skew view of a ``Timer.snapshot()``: per-label
+    ``{"min", "max", "mean"}`` of the phase totals across processes.
+
+    Multi-chip stragglers hide inside a single process's wall clock —
+    the collective phase of a skewed iteration shows up as *waiting* on
+    the fast ranks — so the telemetry recorder runs every snapshot
+    through here. SPMD processes execute the identical loop, hence hold
+    the identical label set; callers must pass the UNFILTERED label set
+    (the recorder does) so every rank joins the allgather with an
+    identical vector shape. The totals are stacked into one vector and
+    allgathered via the existing collective helpers (one small host
+    collective per event, same transport as ``sync_bin_mappers``). A
+    collective failure propagates — failing fast beats the rank-
+    divergent deadlock a per-rank fallback would cause, with some ranks
+    inside the collective and others already past it.
+
+    Single-process: min == max == mean == the local total, so the JSONL
+    schema is invariant to the topology.
+    """
+    import jax
+
+    labels = sorted(snap)
+    totals = np.asarray([snap[lb]["total"] for lb in labels], np.float64)
+    if jax.process_count() > 1 and labels:
+        from jax.experimental import multihost_utils
+        g = np.asarray(
+            multihost_utils.process_allgather(totals))  # [P, L]
+    else:
+        g = totals[None, :]
+    return {lb: {"min": float(g[:, i].min()),
+                 "max": float(g[:, i].max()),
+                 "mean": float(g[:, i].mean()),
+                 "count": int(snap[lb]["count"])}
+            for i, lb in enumerate(labels)}
 
 
 def sync_bin_mappers(mappers: List) -> List:

@@ -241,6 +241,11 @@ def _forest_leaves(stacked: StackedTrees, X: jnp.ndarray) -> jnp.ndarray:
     return jax.vmap(per_tree)(jnp.arange(T))  # [T, n]
 
 
+from .obs import register_jit  # noqa: E402  (after the jitted defs)
+
+register_jit("prediction/forest_leaves", _forest_leaves)
+
+
 def _predict_leaves_jit(stacked, X, T):
     return _forest_leaves(stacked, X).T
 

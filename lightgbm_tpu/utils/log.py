@@ -9,10 +9,12 @@ from __future__ import annotations
 
 import logging
 import sys
+from contextlib import contextmanager
 from typing import Callable, Optional
 
 __all__ = ["log_debug", "log_info", "log_warning", "LightGBMError",
-           "register_logger", "set_verbosity"]
+           "register_logger", "set_verbosity", "get_verbosity",
+           "scoped_verbosity"]
 
 _logger: Optional[logging.Logger] = None
 _info_method = "info"
@@ -41,6 +43,24 @@ def register_logger(logger: logging.Logger, info_method_name: str = "info",
 def set_verbosity(v: int) -> None:
     global _verbosity
     _verbosity = v
+
+
+def get_verbosity() -> int:
+    return _verbosity
+
+
+@contextmanager
+def scoped_verbosity(v: int):
+    """Apply ``Config.verbosity`` for the duration of a train()/cv()/
+    Booster entry point and restore the prior level on exit (reference
+    semantics: ``verbosity=-1`` silences [Info] lines for that call
+    only, it is not a global sticky setting)."""
+    prev = get_verbosity()
+    set_verbosity(v)
+    try:
+        yield
+    finally:
+        set_verbosity(prev)
 
 
 def log_debug(msg: str) -> None:

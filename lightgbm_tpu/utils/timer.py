@@ -10,23 +10,33 @@ asynchronously from Python, so two complementary mechanisms are provided:
   Because dispatch is async, a label's time only reflects device work if
   the section itself synchronizes (the train loop's per-iteration sync
   points do). Enabled with env ``LIGHTGBM_TPU_TIMETAG=1`` or
-  ``Timer.enable()``; ``Timer.log_summary()`` prints the sorted table.
-- every timed section also enters a ``jax.profiler.TraceAnnotation`` so
-  the phases show up as named spans inside ``jax.profiler.trace``
-  captures (the tensorboard/xplane view) even when host timing is off.
+  ``Timer.enable()``; ``Timer.log_summary()`` prints the sorted table and
+  ``Timer.snapshot()`` returns it machine-readable (the telemetry
+  recorder diffs consecutive snapshots into per-iteration phase times).
+- inside an active ``trace_to`` capture, every timed section also enters
+  a ``jax.profiler.TraceAnnotation`` so the phases show up as named
+  spans in the tensorboard/xplane view even when host timing is off.
+
+When neither timing nor tracing is active, ``timed`` yields immediately:
+no jax import, no TraceAnnotation construction, no clock reads — the
+instrumented loop must cost nothing with telemetry off.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 import time
 from collections import defaultdict
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from typing import Dict, Iterator
 
 from .log import log_info
 
 __all__ = ["Timer", "timed", "trace_to"]
+
+# number of live trace_to() captures; touched under Timer._lock
+_tracing = 0
 
 
 class Timer:
@@ -35,6 +45,9 @@ class Timer:
     _acc: Dict[str, float] = defaultdict(float)
     _cnt: Dict[str, int] = defaultdict(int)
     _enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+    # callbacks can fire from user threads and the recorder snapshots
+    # concurrently with additions
+    _lock = threading.Lock()
 
     @classmethod
     def enable(cls, on: bool = True) -> None:
@@ -46,31 +59,65 @@ class Timer:
 
     @classmethod
     def add(cls, label: str, seconds: float) -> None:
-        cls._acc[label] += seconds
-        cls._cnt[label] += 1
+        with cls._lock:
+            cls._acc[label] += seconds
+            cls._cnt[label] += 1
 
     @classmethod
     def reset(cls) -> None:
-        cls._acc.clear()
-        cls._cnt.clear()
+        with cls._lock:
+            cls._acc.clear()
+            cls._cnt.clear()
 
     @classmethod
     def summary(cls) -> Dict[str, float]:
-        return dict(cls._acc)
+        with cls._lock:
+            return dict(cls._acc)
+
+    @classmethod
+    def snapshot(cls) -> Dict[str, Dict[str, float]]:
+        """Consistent ``{label: {"total": seconds, "count": n}}`` copy."""
+        with cls._lock:
+            return {label: {"total": sec, "count": cls._cnt[label]}
+                    for label, sec in cls._acc.items()}
 
     @classmethod
     def log_summary(cls) -> None:
-        if not cls._acc:
+        snap = cls.snapshot()
+        if not snap:
             return
+        grand = sum(v["total"] for v in snap.values()) or 1.0
         log_info("lightgbm_tpu phase timings (host wall):")
-        for label, sec in sorted(cls._acc.items(), key=lambda kv: -kv[1]):
-            log_info(f"  {label:32s} {sec:10.3f} s  x{cls._cnt[label]}")
+        log_info(f"  {'label':32s} {'total s':>10s} {'count':>8s} "
+                 f"{'mean ms':>10s} {'%':>6s}")
+        for label, v in sorted(snap.items(), key=lambda kv: -kv[1]["total"]):
+            sec, cnt = v["total"], int(v["count"])
+            mean_ms = sec / cnt * 1e3 if cnt else 0.0
+            log_info(f"  {label:32s} {sec:10.3f} {cnt:8d} "
+                     f"{mean_ms:10.3f} {100.0 * sec / grand:6.1f}")
+
+
+# shared no-op context: the disabled cost of a timed() section is one
+# flag check + returning this singleton, against the seed's per-call
+# jax import + TraceAnnotation + generator frame
+_NULL = nullcontext()
+
+# jax resolved once on first active use — not at module import (utils
+# load before the backend is configured) and not per call
+_jax = None
+
+
+def _get_jax():
+    global _jax
+    if _jax is None:
+        import jax
+        _jax = jax
+    return _jax
 
 
 @contextmanager
-def timed(label: str) -> Iterator[None]:
-    """Time a phase and annotate it for device traces."""
-    import jax
+def _timed_active(label: str) -> Iterator[None]:
+    jax = _get_jax()
 
     with jax.profiler.TraceAnnotation(label):
         if not Timer._enabled:
@@ -83,11 +130,59 @@ def timed(label: str) -> Iterator[None]:
             Timer.add(label, time.perf_counter() - t0)
 
 
+# resolved lazily: the jax profiler's session slot, so timed() also
+# annotates traces started OUTSIDE trace_to() via the Python API
+# (jax.profiler.start_trace / jax.profiler.trace). Captures triggered
+# against jax.profiler.start_server happen in C++ and are NOT visible
+# here — use trace_to() or LIGHTGBM_TPU_TIMETAG=1 for those. False-y
+# sentinel until jax is imported; None forever if the private attr
+# moved (degrade to library-only detection, never break).
+_profile_state = False
+
+
+def _external_trace_active() -> bool:
+    global _profile_state
+    if _profile_state is False:
+        import sys
+        if "jax" not in sys.modules:
+            return False
+        try:
+            from jax._src.profiler import _profile_state as st
+            _profile_state = st
+        except Exception:
+            _profile_state = None
+    if _profile_state is None:
+        return False
+    try:
+        return _profile_state.profile_session is not None
+    except Exception:
+        return False
+
+
+def timed(label: str):
+    """Time a phase and, inside a trace capture (ours or an externally
+    started jax profiler session), annotate it. A strict no-op (shared
+    null context) when neither timing nor tracing is active."""
+    if not Timer._enabled and not _tracing \
+            and not _external_trace_active():
+        return _NULL
+    return _timed_active(label)
+
+
 @contextmanager
 def trace_to(log_dir: str) -> Iterator[None]:
     """Capture a full device trace (jax.profiler.trace wrapper) — view
-    with tensorboard's profile plugin, or any xplane.pb reader."""
-    import jax
+    with tensorboard's profile plugin, or any xplane.pb reader. While a
+    capture is live, ``timed`` sections emit TraceAnnotation spans even
+    with host timing off."""
+    global _tracing
+    jax = _get_jax()
 
-    with jax.profiler.trace(log_dir):
-        yield
+    with Timer._lock:
+        _tracing += 1
+    try:
+        with jax.profiler.trace(log_dir):
+            yield
+    finally:
+        with Timer._lock:
+            _tracing -= 1

@@ -38,6 +38,15 @@ def test_bench_success_emits_one_json_line():
         assert key in rec, rec
     assert rec["value"] is not None and rec["value"] > 0
     assert "error" not in rec
+    # the embedded run-telemetry block (docs/OBSERVABILITY.md): phase
+    # wall times, jit recompile count, HBM gauges (nulls on CPU)
+    telem = rec["telemetry"]
+    assert isinstance(telem["recompiles"], int) and \
+        telem["recompiles"] >= 1  # at least the grow compile
+    assert telem["phases"], telem
+    for label, v in telem["phases"].items():
+        assert v["total"] >= 0 and v["count"] >= 1, (label, v)
+    assert "bytes_in_use" in telem["hbm"]
 
 
 def test_bench_failure_emits_one_json_line_within_deadline():

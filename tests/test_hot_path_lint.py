@@ -1,0 +1,94 @@
+"""Static guard against the eager-loop regression class.
+
+PROFILE.md (round 5) records a 530 ms/iter regression whose root cause
+was a ``lax`` loop dispatching eagerly — op-by-op through the device
+tunnel — instead of inside one jitted program. Op-level timing looks
+fine in microbenchmarks, so nothing catches it at runtime; this lint
+catches it at review time instead: every ``lax.fori_loop`` /
+``lax.scan`` / ``lax.while_loop`` call in the boosting path
+(``models/gbdt.py`` + ``ops/``) must live inside a function on the
+KNOWN_JITTED allowlist — functions whose only entry is through a
+``jax.jit`` wrapper (``grow_tree``, the fused-iteration program, the
+prediction jits).
+
+Adding a new device loop? Put it behind a jitted entry point, register
+that entry point with ``obs.register_jit`` (so recompiles are counted),
+and add the enclosing function here.
+"""
+
+import ast
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "lightgbm_tpu")
+
+LOOP_NAMES = {"fori_loop", "scan", "while_loop"}
+
+# root-level functions whose bodies are only ever traced (verified:
+# every call path enters through a jax.jit wrapper)
+KNOWN_JITTED = {
+    ("ops/gather.py", "_gather_small"),      # gather_small jit
+    ("ops/grow.py", "_grow_masked_impl"),    # grow_tree jit
+    ("ops/grow.py", "_grow_compact_impl"),   # grow_tree jit
+    ("ops/histogram.py", "_hist_from_rows_impl"),
+    ("ops/histogram.py", "_hist_scatter"),
+    ("ops/predict.py", "_traverse"),         # predict jits
+    ("ops/predict.py", "predict_forest_raw"),
+}
+
+
+def _hot_path_files():
+    out = [os.path.join(PKG, "models", "gbdt.py")]
+    ops = os.path.join(PKG, "ops")
+    out.extend(os.path.join(ops, f) for f in sorted(os.listdir(ops))
+               if f.endswith(".py"))
+    return out
+
+
+def _loop_sites(path):
+    """(lineno, loop_name, root_function) of every lax loop call."""
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+    sites = []
+
+    def visit(node, stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack = stack + [node.name]
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in LOOP_NAMES:
+                root = stack[0] if stack else "<module>"
+                sites.append((node.lineno, fn.attr, root))
+        for child in ast.iter_child_nodes(node):
+            visit(child, stack)
+
+    visit(tree, [])
+    return sites
+
+
+def test_no_eager_lax_loops_in_boosting_path():
+    offenders = []
+    for path in _hot_path_files():
+        rel = os.path.relpath(path, PKG).replace(os.sep, "/")
+        for lineno, loop, root in _loop_sites(path):
+            if (rel, root) not in KNOWN_JITTED:
+                offenders.append(f"{rel}:{lineno}: lax.{loop} in "
+                                 f"{root}() is not on the KNOWN_JITTED "
+                                 "allowlist")
+    assert not offenders, (
+        "eager-dispatch risk (PROFILE.md 530 ms/iter class):\n  "
+        + "\n  ".join(offenders))
+
+
+def test_allowlist_entries_still_exist():
+    """A renamed/deleted function must be pruned from the allowlist —
+    stale entries would silently stop guarding anything."""
+    live = set()
+    for path in _hot_path_files():
+        rel = os.path.relpath(path, PKG).replace(os.sep, "/")
+        for _, _, root in _loop_sites(path):
+            live.add((rel, root))
+    stale = KNOWN_JITTED - live
+    assert not stale, f"prune stale allowlist entries: {sorted(stale)}"
