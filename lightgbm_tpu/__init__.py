@@ -9,8 +9,9 @@ API mirroring the reference python-package.
 """
 
 from .basic import Booster, Dataset, LightGBMError, Sequence
-from .callback import (EarlyStopException, early_stopping, log_evaluation,
-                       record_evaluation, reset_parameter, telemetry)
+from .callback import (EarlyStopException, checkpoint, early_stopping,
+                       log_evaluation, record_evaluation, reset_parameter,
+                       telemetry)
 from .config import Config
 from .engine import CVBooster, cv, train
 from .utils.log import register_logger
@@ -21,7 +22,7 @@ __all__ = [
     "Dataset", "Booster", "CVBooster", "LightGBMError",
     "train", "cv",
     "early_stopping", "log_evaluation", "record_evaluation",
-    "reset_parameter", "telemetry", "EarlyStopException",
+    "reset_parameter", "telemetry", "checkpoint", "EarlyStopException",
     "register_logger", "Config",
 ]
 

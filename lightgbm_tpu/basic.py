@@ -1363,8 +1363,11 @@ class Booster:
                    importance_type: str = "split") -> "Booster":
         s = self.model_to_string(num_iteration, start_iteration,
                                  importance_type)
-        with open(filename, "w") as f:
-            f.write(s)
+        # crash-safe write (same-directory tmp + os.replace, like the
+        # native-lib build and checkpoint snapshots): a killed process
+        # never leaves a truncated model file behind
+        from .utils.atomic import atomic_write_text
+        atomic_write_text(filename, s)
         return self
 
     def _load_model_string(self, s: str) -> None:

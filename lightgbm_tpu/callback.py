@@ -22,7 +22,7 @@ from .utils.log import log_info, log_warning
 
 __all__ = ["EarlyStopException", "CallbackEnv", "log_evaluation",
            "record_evaluation", "reset_parameter", "early_stopping",
-           "telemetry"]
+           "telemetry", "checkpoint"]
 
 
 class EarlyStopException(Exception):
@@ -290,3 +290,14 @@ def telemetry(path: str, registry=None) -> Callable:
     """
     from .obs import TelemetryRecorder
     return _Telemetry(TelemetryRecorder(path, registry=registry))
+
+
+def checkpoint(directory: str, every_n_iters: int = 1,
+               keep: int = 3) -> Callable:
+    """Atomic periodic training snapshots into ``directory`` with
+    auto-resume via ``train(..., resume_from=directory)`` — the
+    fault-tolerance callback (resilience/checkpoint.py). Equivalent to
+    setting ``LIGHTGBM_TPU_CHECKPOINT=<directory>``; inspect snapshots
+    with ``python -m lightgbm_tpu checkpoints <directory>``."""
+    from .resilience.checkpoint import checkpoint as _checkpoint
+    return _checkpoint(directory, every_n_iters=every_n_iters, keep=keep)

@@ -9,6 +9,7 @@ Usage:
     python -m lightgbm_tpu config=train.conf [key=value ...]
     python -m lightgbm_tpu task=train data=train.csv objective=binary
     python -m lightgbm_tpu stats run.jsonl     # summarize telemetry
+    python -m lightgbm_tpu checkpoints <dir>   # inspect snapshots
 
 Config-file syntax matches the reference (application.cpp:50-86 +
 config.cpp KV2Map): one ``key = value`` per line, ``#`` comments;
@@ -17,6 +18,7 @@ command-line pairs override file pairs.
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import Any, Dict, List, Optional
 
@@ -199,6 +201,52 @@ def _task_stats(argv: List[str]) -> int:
     return 0
 
 
+def _task_checkpoints(argv: List[str]) -> int:
+    """``lightgbm_tpu checkpoints <dir>``: list every snapshot the
+    resilience checkpoint callback wrote into a directory, with
+    validation status — the operator view for "can this run resume,
+    and from which iteration?"."""
+    if not argv:
+        print("usage: python -m lightgbm_tpu checkpoints <dir>",
+              file=sys.stderr)
+        return 1
+    directory = argv[0]
+    if not os.path.isdir(directory):
+        print(f"[LightGBM-TPU] [Fatal] not a directory: {directory}",
+              file=sys.stderr)
+        return 1
+    from .resilience.checkpoint import list_snapshots
+    rows = list_snapshots(directory)
+    if not rows:
+        print(f"no checkpoint snapshots in {directory}", file=sys.stderr)
+        return 1
+    import datetime as _dt
+    print(f"{'iteration':>9s}  {'status':8s} {'trees':>6s} "
+          f"{'size':>10s}  {'written':19s}  file")
+    resumable = None
+    for row in rows:
+        when = _dt.datetime.fromtimestamp(
+            row["mtime"]).strftime("%Y-%m-%d %H:%M:%S")
+        if row["status"] == "ok":
+            trees = str(row["num_trees"])
+            resumable = row
+        else:
+            trees = "-"
+        print(f"{row['iteration']:9d}  {row['status']:8s} {trees:>6s} "
+              f"{row['bytes']:10d}  {when}  "
+              f"{os.path.basename(row['path'])}")
+        if row["status"] != "ok":
+            print(f"           ^ {row['error']}")
+    if resumable is not None:
+        print(f"\nresume target: iteration {resumable['iteration']} "
+              f"({os.path.basename(resumable['path'])})")
+    else:
+        print("\nno valid snapshot: this directory cannot be resumed "
+              "from", file=sys.stderr)
+        return 1
+    return 0
+
+
 _TASKS = {
     "train": _task_train,
     "refit": _task_refit,
@@ -218,6 +266,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 0
     if argv[0] == "stats":
         return _task_stats(argv[1:])
+    if argv[0] == "checkpoints":
+        return _task_checkpoints(argv[1:])
     try:
         params = parse_args(argv)
         cfg = Config.from_params(params)

@@ -408,6 +408,12 @@ class Config:
     num_grad_quant_bins: int = 4
     quant_train_renew_leaf: bool = False
     stochastic_rounding: bool = True
+    # non-finite guard on gradients/hessians/fitted leaf values, fused
+    # into the jitted boosting step (resilience/): "raise" fails fast
+    # with a LightGBMError, "skip_tree" drops the poisoned iteration's
+    # trees (they become no-op constants) and keeps training, "clamp"
+    # replaces NaN/Inf with finite values and keeps the trees
+    nonfinite_policy: str = "raise"
 
     # ---- dataset ----
     linear_tree: bool = False
@@ -601,6 +607,10 @@ class Config:
         if self.hist_precision not in ("default", "high", "highest"):
             raise ValueError(
                 f"Unknown hist_precision: {self.hist_precision}")
+        if self.nonfinite_policy not in ("raise", "skip_tree", "clamp"):
+            raise ValueError(
+                f"Unknown nonfinite_policy: {self.nonfinite_policy} "
+                "(expected raise, skip_tree or clamp)")
         for name, spec in self._BOUNDS.items():
             lo, hi = spec[0], spec[1]
             strict = len(spec) > 2 and spec[2] == "gt"

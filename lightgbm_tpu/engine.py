@@ -52,8 +52,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
           init_model: Optional[Union[str, Booster]] = None,
           keep_training_booster: bool = False,
           callbacks: Optional[List[Callable]] = None,
-          fobj: Optional[Callable] = None) -> Booster:
-    """Train one model (engine.py:109 analog)."""
+          fobj: Optional[Callable] = None,
+          resume_from: Optional[str] = None) -> Booster:
+    """Train one model (engine.py:109 analog).
+
+    ``resume_from``: checkpoint directory written by the
+    ``resilience.checkpoint`` callback — the newest valid snapshot is
+    restored and training continues from its iteration toward
+    ``num_boost_round`` *total* iterations (a directory without usable
+    snapshots trains from scratch). The ``LIGHTGBM_TPU_CHECKPOINT``
+    environment variable implies both ``resume_from`` and the
+    checkpoint callback itself; see docs/RESILIENCE.md.
+    """
     params = resolve_params(params)
     # num_boost_round from params wins (alias resolution)
     if "num_iterations" in params:
@@ -63,13 +73,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
     with scoped_verbosity(cfg.verbosity):
         return _train_impl(params, cfg, train_set, num_boost_round,
                            valid_sets, valid_names, feval, init_model,
-                           keep_training_booster, callbacks, fobj)
+                           keep_training_booster, callbacks, fobj,
+                           resume_from)
 
 
 def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
                 num_boost_round: int, valid_sets, valid_names, feval,
                 init_model, keep_training_booster, callbacks,
-                fobj) -> Booster:
+                fobj, resume_from=None) -> Booster:
     if cfg.objective == "custom" and fobj is None:
         raise LightGBMError(
             "objective=none requires a custom objective function (fobj)")
@@ -78,7 +89,25 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
         raise TypeError("train() only accepts Dataset object(s)")
 
     booster = Booster(params=params, train_set=train_set)
-    if init_model is not None:
+
+    # -- crash recovery (resilience/checkpoint.py): an explicit
+    # resume_from wins; LIGHTGBM_TPU_CHECKPOINT is the hands-off env
+    # switch that both resumes from and checkpoints into one directory
+    from .resilience.checkpoint import (Checkpoint, checkpoint,
+                                        load_latest_snapshot,
+                                        restore_booster)
+    ckpt_env = os.environ.get("LIGHTGBM_TPU_CHECKPOINT")
+    resume_dir = resume_from or ckpt_env
+    snap = load_latest_snapshot(resume_dir) if resume_dir else None
+    resumed_iteration = 0
+    if snap is not None:
+        if init_model is not None:
+            log_warning("resume_from checkpoint takes precedence over "
+                        "init_model")
+        resumed_iteration = restore_booster(booster, snap)
+        log_info(f"Resumed from checkpoint {snap['path']} at iteration "
+                 f"{resumed_iteration}")
+    elif init_model is not None:
         # continued training (engine.py init_model -> num_init_iteration)
         if isinstance(init_model, (str, Path)):
             base = Booster(model_file=str(init_model))
@@ -116,6 +145,17 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
             verbose=cfg.verbosity >= 1))
     if cfg.verbosity >= 1 and cfg.is_provide_training_metric:
         pass  # training metric printed through evaluation list below
+    if ckpt_env and not any(isinstance(cb, Checkpoint)
+                            for cb in callbacks):
+        every_raw = os.environ.get("LIGHTGBM_TPU_CHECKPOINT_EVERY", "1")
+        try:
+            every = max(1, int(every_raw or 1))
+        except ValueError:
+            log_warning("LIGHTGBM_TPU_CHECKPOINT_EVERY="
+                        f"{every_raw!r} is not an integer; "
+                        "checkpointing every iteration")
+            every = 1
+        callbacks.append(checkpoint(ckpt_env, every_n_iters=every))
     _setup_telemetry(callbacks, booster)
     cbs_before = {cb for cb in callbacks
                   if getattr(cb, "before_iteration", False)}
@@ -123,21 +163,29 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
     cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
     cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
 
-    begin_iteration = 0
+    from .resilience.faults import FaultPlan
+    fault_plan = FaultPlan.from_env()
+
+    # resume continues toward num_boost_round TOTAL iterations (train
+    # 20 == train 10 then resume to 20); from-scratch runs keep the
+    # plain [0, num_boost_round) loop
+    begin_iteration = resumed_iteration
+    end_iteration = max(resumed_iteration, num_boost_round)
     evaluation_result_list: List[Tuple] = []
     try:
-        for i in range(begin_iteration, begin_iteration + num_boost_round):
+        for i in range(begin_iteration, end_iteration):
+            fault_plan.maybe_kill(i)
             for cb in cbs_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
                     begin_iteration=begin_iteration,
-                    end_iteration=begin_iteration + num_boost_round,
+                    end_iteration=end_iteration,
                     evaluation_result_list=None))
             finished = booster.update(fobj=fobj)
 
             evaluation_result_list = []
             if (i + 1) % max(1, cfg.metric_freq) == 0 or \
-                    i == begin_iteration + num_boost_round - 1:
+                    i == end_iteration - 1:
                 if valid_sets or is_valid_contain_train:
                     with timed("engine/eval"):
                         if is_valid_contain_train:
@@ -150,7 +198,7 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
                     cb(callback_mod.CallbackEnv(
                         model=booster, params=params, iteration=i,
                         begin_iteration=begin_iteration,
-                        end_iteration=begin_iteration + num_boost_round,
+                        end_iteration=end_iteration,
                         evaluation_result_list=evaluation_result_list))
             except callback_mod.EarlyStopException as es:
                 booster.best_iteration = es.best_iteration + 1
@@ -161,6 +209,11 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
                 log_info("Stopped training because there are no more "
                          "leaves that meet the split requirements")
                 break
+        # guard flags of the last fused iteration are still in flight
+        # (the async check runs one iteration late): drain them now so
+        # a fault on the final iteration still enforces its policy
+        if booster._engine is not None:
+            booster._engine.finish_faults()
     finally:
         _finish_callbacks(callbacks)
 

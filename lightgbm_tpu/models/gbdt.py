@@ -28,6 +28,7 @@ import numpy as np
 from ..config import Config
 from ..obs import register_jit
 from ..objectives import Objective
+from ..resilience.faults import FaultPlan, is_resource_exhausted
 from ..ops.gather import gather_small
 from ..ops.grow import GrowConfig, TreeArrays, grow_tree
 from ..ops.predict import predict_leaf_binned
@@ -37,6 +38,31 @@ from .tree import (Tree, pack_tree_device, tree_from_arrays,
                    unpack_tree_host)
 
 __all__ = ["GBDTBooster"]
+
+# non-finite guard (resilience): flag bits and the clamp ceiling
+# (well inside float32 range so downstream sums stay finite)
+_NF_GRAD, _NF_HESS, _NF_LEAF = 1, 2, 4
+_NF_CLAMP = 1e30
+
+
+def _nf_clamp(a, lo, hi):
+    """NaN -> 0, +/-Inf -> the finite bounds (nonfinite_policy=clamp)."""
+    return jnp.clip(jnp.nan_to_num(a, nan=0.0, posinf=hi, neginf=lo),
+                    lo, hi)
+
+
+def _gh_flag_clamp(g, h, policy):
+    """Gradient/hessian finiteness flag + clamp policy — pure jnp, so
+    the eager guard and the fused step trace the SAME implementation
+    (like _leaf_guard; any drift between the two paths would break
+    their documented bit-equality)."""
+    flag = (jnp.where(jnp.all(jnp.isfinite(g)), 0, _NF_GRAD)
+            | jnp.where(jnp.all(jnp.isfinite(h)), 0, _NF_HESS)
+            ).astype(jnp.int32)
+    if policy == "clamp":
+        g = _nf_clamp(g, -_NF_CLAMP, _NF_CLAMP)
+        h = _nf_clamp(h, 0.0, _NF_CLAMP)
+    return g, h, flag
 
 
 @jax.jit
@@ -100,6 +126,19 @@ class GBDTBooster:
         self.iter_ = 0
         self.valid_sets: List[_ValidData] = []
         self._shrinkage = cfg.learning_rate
+
+        # -- resilience state (resilience/): the non-finite guard
+        # policy, the deterministic fault-injection plan (test harness;
+        # inert without LIGHTGBM_TPU_FAULT_INJECT), guard flags in
+        # flight from async device programs, and the fault event log
+        # the telemetry recorder drains --
+        self._nf_policy = cfg.nonfinite_policy
+        self._fault_plan = FaultPlan.from_env()
+        self._guard_async: List[tuple] = []
+        self._fault_recent = False
+        self._resume_stalled = False
+        self._finished_natural = False
+        self.fault_log: List[dict] = []
 
         ds = train_set
         self.n = ds.num_data()
@@ -391,7 +430,174 @@ class GBDTBooster:
     def models(self, v) -> None:
         self._pending_dev = []
         self._nl_async = []
+        self._guard_async = []
+        self._fault_recent = False
+        self._finished_natural = False
         self._models_store = list(v)
+
+    # ------------------------------------------------------------------
+    # resilience: non-finite guard, OOM degradation, fault events
+    # (docs/RESILIENCE.md)
+    # ------------------------------------------------------------------
+    def _record_fault(self, kind: str, iteration: int, action: str,
+                      detail: str) -> None:
+        """Append one fault event (drained into the telemetry JSONL
+        stream by obs/recorder.py) and count it in the global metrics
+        registry. The log is capped: without a telemetry recorder
+        attached nothing drains it, and a clamp/skip_tree run on
+        persistently bad data would otherwise grow it one dict per
+        iteration forever (the registry counter still counts all)."""
+        import time as _time
+        if len(self.fault_log) >= 512:
+            del self.fault_log[0]
+        self.fault_log.append({
+            "event": "fault", "kind": kind, "iteration": int(iteration),
+            "action": action, "detail": detail, "time": _time.time()})
+        try:
+            from ..obs import registry
+            registry.counter("fault_events", kind=kind).inc()
+        except Exception:
+            pass
+        from ..utils.log import log_warning
+        log_warning(f"fault[{kind}] at iteration {iteration}: {detail} "
+                    f"-> {action}")
+
+    def _gh_guard(self, it: int, grad, hess):
+        """Eager-path gradient/hessian guard: fault injection, one
+        fused finiteness reduction -> flag bits, and the clamp policy
+        applied in place. The fused fast path traces the identical ops
+        inside its single program (_get_fused_fn)."""
+        if self._fault_plan.fires("nan_grad", it):
+            grad = jnp.full_like(grad, jnp.nan)
+        if self._fault_plan.fires("nan_hess", it):
+            hess = jnp.full_like(hess, jnp.nan)
+        return _gh_flag_clamp(grad, hess, self._nf_policy)
+
+    def _leaf_guard(self, dev_tree, gh_flag):
+        """Fitted-leaf-value guard: extend the iteration flag and apply
+        the policy on device — clamp rewrites the leaf table,
+        skip_tree demotes the tree to a no-op constant (the
+        AsConstantTree path downstream)."""
+        lv = dev_tree.leaf_value
+        flag = gh_flag | jnp.where(jnp.all(jnp.isfinite(lv)), 0,
+                                   _NF_LEAF).astype(jnp.int32)
+        if self._nf_policy == "clamp":
+            dev_tree = dev_tree._replace(
+                leaf_value=_nf_clamp(lv, -_NF_CLAMP, _NF_CLAMP))
+        elif self._nf_policy == "skip_tree":
+            ok = flag == 0
+            dev_tree = dev_tree._replace(
+                num_leaves=jnp.where(ok, dev_tree.num_leaves, 1),
+                leaf_value=jnp.where(ok, lv, jnp.zeros_like(lv)))
+        return dev_tree, flag
+
+    def _push_guard_flags(self, it: int, flags) -> None:
+        """Queue a guard flag for the one-iteration-late async check
+        (same non-stalling contract as the _nl_async tree queue)."""
+        try:
+            flags.copy_to_host_async()
+        except AttributeError:  # non-jax arrays (tests/cpu)
+            pass
+        self._guard_async.append((it, flags))
+
+    def _apply_guard_flag(self, it: int, flag: int) -> None:
+        """Record + enforce the configured policy for one iteration's
+        non-finite guard flag."""
+        if not flag:
+            return
+        kinds = [name for bit, name in ((_NF_GRAD, "gradients"),
+                                        (_NF_HESS, "hessians"),
+                                        (_NF_LEAF, "leaf values"))
+                 if flag & bit]
+        detail = "non-finite " + ", ".join(kinds)
+        self._record_fault("nonfinite", it, self._nf_policy, detail)
+        if self._nf_policy == "raise":
+            from ..basic import LightGBMError
+            raise LightGBMError(
+                f"{detail} detected at iteration {it} "
+                "(nonfinite_policy=raise; use skip_tree or clamp to "
+                "train through transient numerical faults)")
+
+    def _drain_guard_flags(self) -> bool:
+        """Resolve guard flags from previous async programs. A fired
+        fault also sets the STICKY ``_fault_recent`` marker: callers
+        other than the train step drain too (checkpoint writes, the
+        end-of-training flush), and the next train step must still know
+        not to interpret a 1-leaf tree in ``_nl_async`` as natural
+        end-of-training — skip_tree demotions look identical to
+        no-growth. The train step clears the marker when it consumes
+        the matching ``_nl_async`` entries."""
+        fired = False
+        pending, self._guard_async = self._guard_async, []
+        for it, flags in pending:
+            fl = int(np.bitwise_or.reduce(
+                np.atleast_1d(np.asarray(flags)).ravel()))
+            if fl:
+                fired = True
+                self._apply_guard_flag(it, fl)
+        if fired:
+            self._fault_recent = True
+        return fired
+
+    def finish_faults(self) -> None:
+        """Drain guard flags still in flight after the final iteration
+        (the fused path checks one iteration late); called by the train
+        loop before returning the booster."""
+        self._drain_guard_flags()
+
+    def _run_with_oom_degrade(self, thunk, what: str):
+        """Run a grow/fused dispatch with graceful OOM degradation:
+        on RESOURCE_EXHAUSTED, downgrade the histogram strategy (MXU
+        matmul -> scatter, then histogram-pool halving), rebuild the
+        affected jitted programs and retry; re-raise as a clear
+        LightGBMError once nothing is left to shed."""
+        while True:
+            try:
+                self._fault_plan.maybe_oom(self.iter_)
+                return thunk()
+            except Exception as e:
+                if not is_resource_exhausted(e):
+                    raise
+                if not self._degrade_after_oom(e, what):
+                    from ..basic import LightGBMError
+                    raise LightGBMError(
+                        f"device RESOURCE_EXHAUSTED in {what} at "
+                        f"iteration {self.iter_} and no degradation "
+                        f"left to try: {e}") from e
+
+    def _degrade_after_oom(self, exc, what: str) -> bool:
+        """Apply one degradation step; False when exhausted."""
+        gcfg = self.grow_cfg
+        if gcfg.hist_method == "mxu":
+            self.grow_cfg = gcfg._replace(hist_method="scatter")
+            action = "hist_method mxu -> scatter"
+        else:
+            cur = gcfg.hist_pool_slots if gcfg.hist_pool_slots > 0 \
+                else gcfg.num_leaves
+            slots = max(2, cur // 2)
+            if slots >= cur:
+                return False
+            self.grow_cfg = gcfg._replace(hist_pool_slots=slots)
+            action = f"histogram pool -> {slots} slots"
+        # drop every cached program that baked the old grow_cfg in
+        self._fused_fn = None
+        self._fused_proto = None
+        if self.mesh is not None and self._grow_fn is not None:
+            self._grow_fn = self._build_grow_fn()
+        detail = f"RESOURCE_EXHAUSTED in {what}; retrying after downgrade"
+        # the fused program DONATES the score buffer (donate_argnums):
+        # a real mid-execution OOM on TPU/GPU leaves self.score deleted
+        # and the retry would die on "Array has been deleted" instead
+        # of the degraded program. Rebuild the score from the
+        # materialized trees — last-ulp different from the incremental
+        # accumulation (bit-exact resume vs an uninterrupted run is
+        # forfeited past this point, which an OOM'd run already is).
+        if getattr(self.score, "is_deleted", lambda: False)():
+            self.score = self._score_dataset_binned(self.train_set)
+            detail += "; score buffer was donated to the failed " \
+                      "dispatch — rebuilt from trees"
+        self._record_fault("oom", self.iter_, action, detail)
+        return True
 
     def _flush_pending(self) -> None:
         if not self._pending_dev:
@@ -441,16 +647,27 @@ class GBDTBooster:
             return None
         return {"trees": K, "leaves": leaves, "split_gain_sum": gain}
 
-    def preload_models(self, trees: List[Tree]) -> None:
+    def preload_models(self, trees: List[Tree],
+                       score: Optional[np.ndarray] = None) -> None:
         """Continue training from an existing model (the reference's
         init_model / num_init_iteration path, gbdt.cpp Init +
         boosting.h:307): adopt the trees and rebuild the train score by
         binned traversal. boost_from_average stays un-refolded because
-        iteration indices continue past 0."""
+        iteration indices continue past 0.
+
+        ``score``: install this [K, n] raw-score matrix verbatim
+        instead of re-traversing the trees — the checkpoint-resume path
+        (resilience/checkpoint.py) uses it because the incrementally
+        accumulated f32 score and a fresh traversal can differ in the
+        last ulp, which would break bit-exact resume."""
         self.models = list(trees)
         self._tree_weights = [1.0] * len(self.models)
         self.iter_ = len(self.models) // self.K
-        self.score = self._score_dataset_binned(self.train_set)
+        if score is not None:
+            self.score = jnp.asarray(
+                np.asarray(score, np.float32).reshape(self.K, self.n))
+        else:
+            self.score = self._score_dataset_binned(self.train_set)
 
     # ------------------------------------------------------------------
     def add_valid(self, dataset, name: str) -> None:
@@ -857,6 +1074,16 @@ class GBDTBooster:
         bynode = gcfg.bynode < 1.0
         base_key = self._base_key
         bynode_key = self._bynode_key
+        nf_policy = self._nf_policy
+        # fault injection (test harness): the schedule is static per
+        # engine, so the poisoning folds into the traced program as a
+        # where(it == N) — zero recompiles, exact device-side replay
+        inj_grad = jnp.asarray(self._fault_plan.iters("nan_grad"),
+                               jnp.int32) \
+            if self._fault_plan.iters("nan_grad") else None
+        inj_hess = jnp.asarray(self._fault_plan.iters("nan_hess"),
+                               jnp.int32) \
+            if self._fault_plan.iters("nan_hess") else None
 
         # the pending-tree proto (ShapeDtypeStructs for unpack at
         # flush) is config-static: derive it once by abstract eval
@@ -884,12 +1111,25 @@ class GBDTBooster:
                                  weight)
             if K == 1:
                 g, h = g[None, :], h[None, :]
+            if inj_grad is not None:
+                g = jnp.where(jnp.any(it == inj_grad),
+                              jnp.float32(jnp.nan), g)
+            if inj_hess is not None:
+                h = jnp.where(jnp.any(it == inj_hess),
+                              jnp.float32(jnp.nan), h)
+            # non-finite guard, fused into this one program via the
+            # same pure-jnp helper the eager path uses: the isfinite
+            # reductions cost a single pass; the resulting flag rides
+            # back with the tree outputs and is checked one iteration
+            # late on the host (no per-iteration device sync)
+            g, h, gh_flag = _gh_flag_clamp(g, h, nf_policy)
             # identical key schedule to the eager path (fold_in is a
             # pure device op, so tracing it keeps streams bit-equal)
             qk_it = jax.random.fold_in(base_key, it) if quant else None
             nk_it = jax.random.fold_in(bynode_key, it) if bynode else None
             new_score = score
             outs = []
+            flags = []
             for k in range(K):
                 qk = jax.random.fold_in(qk_it, k) if quant else None
                 nk = jax.random.fold_in(nk_it, k) if bynode else None
@@ -897,6 +1137,9 @@ class GBDTBooster:
                     gcfg, bins_T, g[k], h[k], row_w, fmask, fnb, fnan,
                     monotone, feat_is_cat, qk, igroups, forced, None,
                     nk, bundle)
+                # _leaf_guard is pure jnp, so the eager helper traces
+                # here verbatim — one implementation, both paths
+                dev_tree, flag_k = self._leaf_guard(dev_tree, gh_flag)
                 vec, cmask = pack_tree_device(dev_tree)
                 contrib = gather_small(dev_tree.leaf_value, row_leaf)
                 # a no-growth tree is replaced by a constant at flush
@@ -905,7 +1148,8 @@ class GBDTBooster:
                                     0.0)
                 new_score = new_score.at[k].add(contrib * shrink)
                 outs.append((vec, cmask, dev_tree.num_leaves))
-            return new_score, outs
+                flags.append(flag_k)
+            return new_score, outs, jnp.stack(flags)
 
         # donate the old score buffer (it is consumed) — except on CPU,
         # where XLA ignores donation and warns
@@ -947,15 +1191,21 @@ class GBDTBooster:
                 if self._fmask_cached is None:
                     self._fmask_cached = self._feature_mask()
                 fmask = self._fmask_cached
-        fn = self._get_fused_fn()
         with timed("boosting/fused_iter"):
-            new_score, outs = fn(
-                self.score, jnp.asarray(it, jnp.int32),
-                jnp.asarray(self._shrinkage, jnp.float32), row_w, fmask,
-                self.bins_T, self.feat_num_bins, self.feat_nan_bin,
-                self.label, self.weight, self.monotone, self.feat_is_cat,
-                self.interaction_groups, self.forced, self._bundle_dev)
+            # thunk re-reads _get_fused_fn so an OOM downgrade's
+            # rebuilt program is picked up on the retry
+            new_score, outs, guard_flags = self._run_with_oom_degrade(
+                lambda: self._get_fused_fn()(
+                    self.score, jnp.asarray(it, jnp.int32),
+                    jnp.asarray(self._shrinkage, jnp.float32), row_w,
+                    fmask, self.bins_T, self.feat_num_bins,
+                    self.feat_nan_bin, self.label, self.weight,
+                    self.monotone, self.feat_is_cat,
+                    self.interaction_groups, self.forced,
+                    self._bundle_dev),
+                "fused iteration")
         self.score = new_score
+        self._push_guard_flags(it, guard_flags)
         fold_now = it == 0 and self._fold_bias
         for k, (vec, cmask, num_leaves) in enumerate(outs):
             bias = float(self.init_score[k]) if fold_now else 0.0
@@ -988,14 +1238,40 @@ class GBDTBooster:
         cfg = self.cfg
         it = self.iter_
 
+        # non-finite guard flags from the previous (async) program,
+        # checked one iteration late like the tree queue below —
+        # raises/records per nonfinite_policy (resilience/)
+        self._drain_guard_flags()
+
+        # checkpoint-restored no-growth marker: the snapshot's final
+        # iteration grew nothing, so an uninterrupted run's next
+        # update() would stop BEFORE growing — byte-exact resume must
+        # stop at the same point instead of regrowing an extra
+        # constant tree (resilience/checkpoint.py "stalled")
+        if self._resume_stalled:
+            self._resume_stalled = False
+            if custom_grad is None:
+                self._finished_natural = True
+                return True
+
         # deferred-mode no-growth check, one iteration late: the async
         # copies were started last iteration so this read doesn't stall.
         # Custom gradients always get a fresh attempt (the reference's
         # TrainOneIterCustom never short-circuits on past iterations).
+        # A recent fault suppresses the short-circuit: a skip_tree
+        # demotion is indistinguishable from natural no-growth in the
+        # leaf counts alone. The STICKY marker (not the drain's return
+        # value) carries that across out-of-band drains — a checkpoint
+        # callback draining between iterations must not eat it.
         if self._nl_async:
             nls = [int(np.asarray(x)) for x in self._nl_async]
             self._nl_async = []
-            if custom_grad is None and all(nl <= 1 for nl in nls):
+            fault_recent, self._fault_recent = self._fault_recent, False
+            if custom_grad is None and not fault_recent \
+                    and all(nl <= 1 for nl in nls):
+                # remembered past the drain: a checkpoint written after
+                # this point must still carry the stalled marker
+                self._finished_natural = True
                 return True
 
         # Fast path: the whole iteration (gradients -> grow -> tree pack
@@ -1035,6 +1311,10 @@ class GBDTBooster:
             else:
                 grad, hess = self._gradients(self.score)
 
+        # non-finite guard (+ fault injection) before anything consumes
+        # the gradients; GOSS sampling below sees the clamped values
+        grad, hess, gh_flag = self._gh_guard(it, grad, hess)
+
         with timed("boosting/bagging"):
             row_w = self._row_weights(it, grad[0] if self.K == 1 else grad,
                                       hess[0] if self.K == 1 else hess)
@@ -1042,6 +1322,13 @@ class GBDTBooster:
 
         shrinkage = self._shrinkage if cfg.boosting != "rf" else 1.0
         grew_any = False
+        # loop-invariant defer gate (hoisted from the k loop): guard
+        # flags travel async in defer mode, synchronously otherwise
+        defer = (not self.valid_sets and cfg.boosting == "gbdt"
+                 and not cfg.linear_tree)
+        iter_flag = None   # device-side OR of this iteration's flags
+        sync_flag = 0      # host-side flags (non-defer path)
+        fault_now = False
         quant_key = None
         if cfg.use_quantized_grad and cfg.stochastic_rounding:
             quant_key = jax.random.fold_in(self._base_key, it)
@@ -1074,7 +1361,8 @@ class GBDTBooster:
                 if self._bundle_dev is not None:
                     args = args + self._bundle_dev
                 with timed("tree_learner/grow"):
-                    dev_tree, row_leaf = self._grow_fn(*args)
+                    dev_tree, row_leaf = self._run_with_oom_degrade(
+                        lambda: self._grow_fn(*args), "distributed grow")
                 row_leaf = row_leaf[: self.n]
             else:
                 cegb_arrays = None
@@ -1084,25 +1372,27 @@ class GBDTBooster:
                                    self._cegb_coupled,
                                    self._cegb_lazy_used)
                 with timed("tree_learner/grow"):
-                    out = grow_tree(
-                        self.grow_cfg, self.bins_T, grad[k], hess[k],
-                        row_w, fmask, self.feat_num_bins,
-                        self.feat_nan_bin,
-                        self.monotone, self.feat_is_cat,
-                        None if quant_key is None
-                        else jax.random.fold_in(quant_key, k),
-                        self.interaction_groups, self.forced, cegb_arrays,
-                        None if node_key is None
-                        else jax.random.fold_in(node_key, k),
-                        self._bundle_dev)
+                    out = self._run_with_oom_degrade(
+                        lambda: grow_tree(
+                            self.grow_cfg, self.bins_T, grad[k], hess[k],
+                            row_w, fmask, self.feat_num_bins,
+                            self.feat_nan_bin,
+                            self.monotone, self.feat_is_cat,
+                            None if quant_key is None
+                            else jax.random.fold_in(quant_key, k),
+                            self.interaction_groups, self.forced,
+                            cegb_arrays,
+                            None if node_key is None
+                            else jax.random.fold_in(node_key, k),
+                            self._bundle_dev), "grow")
                 if self.cegb_enabled:
                     dev_tree, row_leaf, self._cegb_coupled, lz = out
                     if self.cegb_lazy:
                         self._cegb_lazy_used = lz
                 else:
                     dev_tree, row_leaf = out
-            defer = (not self.valid_sets and cfg.boosting == "gbdt"
-                     and not cfg.linear_tree)
+            dev_tree, k_flag = self._leaf_guard(dev_tree, gh_flag)
+            iter_flag = k_flag if iter_flag is None else iter_flag | k_flag
             if defer:
                 # no blocking scalar fetch: the no-growth check runs one
                 # iteration late off an async copy (see top of method);
@@ -1110,6 +1400,7 @@ class GBDTBooster:
                 num_leaves = 2
             else:
                 num_leaves = int(np.asarray(dev_tree.num_leaves))
+                sync_flag |= int(np.asarray(k_flag))
             if num_leaves <= 1:
                 # constant tree; carries the boost_from_average bias when
                 # it is the first iteration (gbdt.cpp models_.size() check /
@@ -1229,11 +1520,25 @@ class GBDTBooster:
                     v.score = v.score.at[k].add(
                         self._predict_tree_binned_host(tree, v.dataset))
 
+        if defer:
+            if iter_flag is not None:
+                self._push_guard_flags(it, iter_flag)
+        elif sync_flag:
+            # non-defer paths already fetched num_leaves, so the flag
+            # read cost nothing extra: record/raise at the exact
+            # iteration, and keep training through a skip_tree demotion
+            # (a fault is not "no more leaves to split")
+            fault_now = True
+            self._apply_guard_flag(it, sync_flag)
+
         if cfg.boosting == "dart" and drop_idx and grew_any:
             self._dart_normalize(drop_idx)
 
         self.iter_ += 1
-        return not grew_any
+        finished = not grew_any and not fault_now
+        if finished:
+            self._finished_natural = True
+        return finished
 
     # ------------------------------------------------------------------
     # DART (dart.hpp)
@@ -1308,6 +1613,9 @@ class GBDTBooster:
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:454)."""
         self._nl_async = []
+        self._guard_async = []
+        self._fault_recent = False
+        self._finished_natural = False
         if not self.models:
             return
         is_rf = self.cfg.boosting == "rf"

@@ -103,7 +103,12 @@ class TelemetryRecorder:
                 self._file = None
 
     def close(self) -> None:
-        """Flush and restore the Timer to its pre-attach state."""
+        """Flush and restore the Timer to its pre-attach state. Fault
+        events still queued on the engines are drained first — with
+        ``nonfinite_policy=raise`` the exception unwinds before the
+        next ``record_iteration``, and the fault line must not be
+        lost with it."""
+        self._drain_fault_events()
         if self._file is not None:
             self._file.close()
             self._file = None
@@ -161,6 +166,36 @@ class TelemetryRecorder:
                 continue
         return out
 
+    def _write_line(self, obj: dict) -> None:
+        """One JSONL line; an OSError (ENOSPC etc.) degrades to
+        registry-only recording instead of breaking training."""
+        if self._file is None:
+            return
+        try:
+            self._file.write(json.dumps(obj) + "\n")
+            self._file.flush()
+        except OSError as e:
+            from ..utils.log import log_warning
+            log_warning(f"telemetry: write to {self.path!r} failed "
+                        f"({e}); stopping the event stream")
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+
+    def _drain_fault_events(self) -> None:
+        """Move fault events (non-finite guard trips, OOM downgrades;
+        models/gbdt.py ``fault_log``) into the JSONL stream. The
+        engines already counted them in the metrics registry."""
+        for eng in self._engines:
+            log = getattr(eng, "fault_log", None)
+            if not log:
+                continue
+            events, log[:] = list(log), []
+            for ev in events:
+                self._write_line(ev)
+
     def record_iteration(self, iteration: int,
                          evals: Optional[Sequence] = None) -> dict:
         """Assemble, register and write the event for one iteration."""
@@ -171,6 +206,15 @@ class TelemetryRecorder:
             multiproc = jax.process_count() > 1
         except Exception:
             multiproc = False
+        if multiproc and self._engines:
+            # SPMD sanity guard: this event is already a host-level
+            # collective sync point, so the cheap [2]-int agreement
+            # check rides along (resilience; parallel/spmd.py)
+            from ..parallel.spmd import verify_step_consistency
+            eng = self._engines[0]
+            ntrees = len(getattr(eng, "_models_store", []) or []) \
+                + len(getattr(eng, "_pending_dev", []) or [])
+            verify_step_consistency(int(iteration), ntrees)
         phases = self._phase_delta(keep_all=multiproc)
         if multiproc:
             from ..parallel.spmd import aggregate_phase_snapshot
@@ -190,19 +234,8 @@ class TelemetryRecorder:
             "eval": self._eval_dict(evals),
         }
         self._feed_registry(event)
-        if self._file is not None:
-            try:
-                self._file.write(json.dumps(event) + "\n")
-                self._file.flush()
-            except OSError as e:  # ENOSPC etc. — degrade, keep training
-                from ..utils.log import log_warning
-                log_warning(f"telemetry: write to {self.path!r} failed "
-                            f"({e}); stopping the event stream")
-                try:
-                    self._file.close()
-                except OSError:
-                    pass
-                self._file = None
+        self._drain_fault_events()  # fault lines precede their iteration
+        self._write_line(event)
         self.events_written += 1
         return event
 
@@ -236,6 +269,7 @@ def summarize_events(path: str) -> dict:
     gain = 0.0
     wall = 0.0
     last_eval: Dict[str, float] = {}
+    faults: Dict[str, int] = {}
     with open(path, encoding="utf-8") as fh:
         for line in fh:
             line = line.strip()
@@ -245,6 +279,10 @@ def summarize_events(path: str) -> dict:
             if not isinstance(ev, dict):
                 raise ValueError(
                     f"telemetry line is not a JSON object: {line[:80]!r}")
+            if ev.get("event") == "fault":
+                kind = str(ev.get("kind", "unknown"))
+                faults[kind] = faults.get(kind, 0) + 1
+                continue
             if ev.get("event") != "iteration":
                 continue
             iters += 1
@@ -276,7 +314,7 @@ def summarize_events(path: str) -> dict:
     return {"iterations": iters, "wall_time": wall, "phases": phases,
             "recompiles": recompiles, "peak_hbm_bytes": peak_hbm,
             "total_leaves": leaves, "total_split_gain": gain,
-            "last_eval": last_eval}
+            "last_eval": last_eval, "faults": faults}
 
 
 def render_stats_table(summary: dict) -> str:
@@ -290,6 +328,11 @@ def render_stats_table(summary: dict) -> str:
                  (f"{hbm / 2**20:.1f} MiB" if hbm is not None else "n/a"))
     lines.append(f"leaves grown         : {summary['total_leaves']}")
     lines.append(f"split gain sum       : {summary['total_split_gain']:g}")
+    faults = summary.get("faults") or {}
+    if faults:
+        per_kind = ", ".join(f"{k}={v}" for k, v in sorted(faults.items()))
+        lines.append(f"fault events         : {sum(faults.values())} "
+                     f"({per_kind})")
     for key, val in sorted(summary["last_eval"].items()):
         lines.append(f"final {key:15s}: {val:g}")
     phases = summary["phases"]

@@ -29,7 +29,38 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["sync_bin_mappers", "distributed_dataset",
-           "aggregate_phase_snapshot"]
+           "aggregate_phase_snapshot", "verify_step_consistency"]
+
+
+def verify_step_consistency(iteration: int, num_trees: int) -> None:
+    """SPMD sanity guard: every process must agree on the iteration
+    index and tree count at each host-level sync point (telemetry
+    events, checkpoint writes).
+
+    SPMD training computes the identical replicated model on every
+    process, so any divergence here means a rank skipped or repeated an
+    iteration — the failure mode that otherwise surfaces as a silent
+    collective deadlock (ranks waiting in different allgathers) or as
+    quietly different models per rank. One tiny [2]-int64 allgather per
+    sync turns that into an immediate, attributable ``LightGBMError``.
+    Single-process: free no-op."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    local = np.asarray([int(iteration), int(num_trees)], np.int64)
+    g = np.asarray(multihost_utils.process_allgather(local))  # [P, 2]
+    if not (g == g[0]).all():
+        from ..basic import LightGBMError
+        detail = "; ".join(
+            f"rank {r}: iteration={int(a)}, trees={int(b)}"
+            for r, (a, b) in enumerate(g))
+        raise LightGBMError(
+            "SPMD divergence: processes disagree on the training step "
+            f"({detail}). The replicated models are no longer "
+            "identical — aborting instead of hanging in a collective.")
 
 
 def aggregate_phase_snapshot(snap: dict) -> dict:

@@ -1,0 +1,39 @@
+"""Fault-tolerant training: survive the failures long accelerator runs
+actually hit.
+
+- :mod:`~lightgbm_tpu.resilience.checkpoint` — atomic periodic
+  snapshots (model text + score matrix + RNG/bagging state) with
+  retention, and ``train(..., resume_from=dir)`` /
+  ``LIGHTGBM_TPU_CHECKPOINT`` auto-resume that reproduces the
+  uninterrupted model bit-for-bit on CPU.
+- non-finite guard — gradients, hessians and fitted leaf values are
+  finiteness-checked *inside* the jitted boosting step (one fused
+  reduction); the ``nonfinite_policy`` config field picks raise /
+  skip_tree / clamp (models/gbdt.py).
+- OOM degradation — ``RESOURCE_EXHAUSTED`` during a grow dispatch
+  downgrades the histogram path (MXU matmul -> scatter, then histogram
+  pool halving) and retries instead of killing the run.
+- SPMD sanity guard — :func:`~lightgbm_tpu.parallel.spmd.
+  verify_step_consistency` turns silent multi-process divergence into a
+  clear ``LightGBMError``.
+- :mod:`~lightgbm_tpu.resilience.faults` — the deterministic
+  ``LIGHTGBM_TPU_FAULT_INJECT`` harness the tests drive all of the
+  above with.
+
+Every fault surfaces as a ``{"event": "fault", ...}`` line in the
+telemetry JSONL stream (docs/OBSERVABILITY.md) and a
+``fault_events{kind=...}`` registry counter. See docs/RESILIENCE.md.
+"""
+
+from .checkpoint import (Checkpoint, CheckpointError, checkpoint,
+                         list_snapshots, load_latest_snapshot,
+                         load_snapshot, restore_booster, snapshot_path,
+                         write_snapshot)
+from .faults import FaultPlan, InjectedResourceExhausted, is_resource_exhausted
+
+__all__ = [
+    "checkpoint", "Checkpoint", "CheckpointError", "snapshot_path",
+    "write_snapshot", "load_snapshot", "load_latest_snapshot",
+    "list_snapshots", "restore_booster",
+    "FaultPlan", "InjectedResourceExhausted", "is_resource_exhausted",
+]

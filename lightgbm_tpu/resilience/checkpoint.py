@@ -1,0 +1,417 @@
+"""Atomic training checkpoints + auto-resume.
+
+One snapshot = one ``ckpt_<iteration>.npz`` file written atomically
+(in-memory npz -> same-directory tmp -> ``os.replace``, via
+utils/atomic.py), so a process killed mid-write can never leave a
+truncated snapshot: the directory always holds only complete files plus
+at most one orphaned ``*.tmp`` that readers ignore.
+
+A snapshot carries everything a bit-exact continuation needs:
+
+- the model text (same ``%.17g`` format as ``save_model``; float64
+  leaf values round-trip exactly),
+- the raw-score matrix ``[K, n]`` float32 — restored verbatim instead
+  of being recomputed from trees, because the incremental in-program
+  f32 score accumulation and a from-scratch traversal can differ in the
+  last ulp, which would eventually flip a split,
+- the host RNG streams (per-tree feature sampling, DART drop RNG) by
+  Mersenne state, and per-model tree weights,
+- bookkeeping: iteration, best_score / best_iteration, string
+  attributes, and a parameter fingerprint (mismatches at resume warn,
+  they do not fail).
+
+Device-keyed streams (bagging, GOSS, quantization, by-node sampling)
+are pure ``fold_in(key, iteration)`` functions and need no state; the
+bagging *cache* (re-used between refresh iterations) is re-derived at
+restore from the last refresh iteration's key.
+
+Resume flow: ``train(..., resume_from=dir)`` — or the
+``LIGHTGBM_TPU_CHECKPOINT=<dir>`` environment variable, which also
+installs the checkpoint callback — loads the newest snapshot that
+validates, silently skipping corrupted/truncated files in favor of the
+previous one, and continues training at the recorded iteration toward
+``num_boost_round`` *total* iterations. See docs/RESILIENCE.md.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.atomic import atomic_write_bytes
+from ..utils.log import log_info, log_warning
+
+__all__ = ["checkpoint", "Checkpoint", "CheckpointError", "snapshot_path",
+           "write_snapshot", "load_snapshot", "load_latest_snapshot",
+           "list_snapshots", "restore_booster"]
+
+CHECKPOINT_MAGIC = "lightgbm_tpu.checkpoint.v1"
+_FILE_RE = re.compile(r"^ckpt_(\d{8})\.npz$")
+
+
+class CheckpointError(ValueError):
+    """A snapshot file failed validation (corrupt / truncated / foreign)."""
+
+
+def snapshot_path(directory: str, iteration: int) -> str:
+    return os.path.join(os.fspath(directory), f"ckpt_{iteration:08d}.npz")
+
+
+# ---------------------------------------------------------------------
+# RNG state (numpy legacy MT19937 tuple) <-> npz-storable pieces
+# ---------------------------------------------------------------------
+
+def _rng_state_arrays(rng: np.random.RandomState):
+    name, keys, pos, has_gauss, cached = rng.get_state()
+    meta = {"name": name, "pos": int(pos), "has_gauss": int(has_gauss),
+            "cached_gaussian": float(cached)}
+    return np.asarray(keys, np.uint32), meta
+
+
+def _rng_restore(rng: np.random.RandomState, keys: np.ndarray,
+                 meta: Dict[str, Any]) -> None:
+    rng.set_state((meta["name"], np.asarray(keys, np.uint32),
+                   int(meta["pos"]), int(meta["has_gauss"]),
+                   float(meta["cached_gaussian"])))
+
+
+# ---------------------------------------------------------------------
+# write
+# ---------------------------------------------------------------------
+
+def write_snapshot(directory: str, booster, keep: int = 3) -> str:
+    """Snapshot ``booster`` into ``directory`` atomically; prune old
+    snapshots beyond ``keep``. Returns the snapshot path."""
+    eng = booster._engine
+    if eng is None:
+        raise CheckpointError(
+            "cannot checkpoint a prediction-only Booster (no engine)")
+    # drain the one-iteration-late non-finite guard flags FIRST: under
+    # nonfinite_policy=raise a poisoned iteration must raise here,
+    # before its NaN trees/score become the newest "valid" snapshot
+    # that auto-resume would then restore forever
+    drain = getattr(eng, "finish_faults", None)
+    if drain is not None:
+        drain()
+    # the one-iteration-late no-growth marker must survive resume: if
+    # the just-finished iteration grew nothing (and not because a
+    # skip_tree fault demoted it), the NEXT update() of an
+    # uninterrupted run stops before growing — a resumed run has to
+    # make the same call, or it regrows an extra constant tree (and
+    # burns an extra feature-RNG draw), breaking byte-exact resume.
+    # Reading the async counts does not consume the engine's queue.
+    nl_pending = [int(np.asarray(x))
+                  for x in getattr(eng, "_nl_async", [])]
+    stalled = (getattr(eng, "_finished_natural", False)
+               or (bool(nl_pending) and all(nl <= 1 for nl in nl_pending)
+                   and not getattr(eng, "_fault_recent", False)))
+    # model_to_string flushes the async pending-tree queue, so the
+    # score fetched below is consistent with the serialized trees
+    model_str = booster.model_to_string()
+    iteration = int(eng.iter_)
+    frng_keys, frng_meta = _rng_state_arrays(eng._feature_rng)
+    drng_keys, drng_meta = _rng_state_arrays(eng._dart_rng)
+    state = {
+        "magic": CHECKPOINT_MAGIC,
+        "iteration": iteration,
+        "num_trees": len(booster._models),
+        "num_model_per_iteration": int(eng.K),
+        "best_iteration": int(booster.best_iteration),
+        "best_score": {str(d): {str(m): float(v)
+                                for m, v in sub.items()}
+                       for d, sub in (booster.best_score or {}).items()},
+        "tree_weights": [float(w) for w in eng._tree_weights],
+        "feature_rng": frng_meta,
+        "dart_rng": drng_meta,
+        "attrs": dict(booster._attrs),
+        "train_data_name": booster._train_data_name,
+        "params_fingerprint": _params_fingerprint(booster.params),
+        "data_fingerprint": _dataset_fingerprint(eng),
+        "stalled": stalled,
+    }
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        state_json=np.frombuffer(
+            json.dumps(state).encode("utf-8"), np.uint8),
+        model_str=np.frombuffer(model_str.encode("utf-8"), np.uint8),
+        score=np.asarray(eng.score, np.float32),
+        frng_keys=frng_keys,
+        drng_keys=drng_keys,
+    )
+    path = snapshot_path(directory, iteration)
+    atomic_write_bytes(path, buf.getvalue())
+    _prune(os.fspath(directory), keep)
+    return path
+
+
+def _dataset_fingerprint(eng) -> Dict[str, Any]:
+    """Cheap identity of the TRAINING DATA a snapshot was written
+    against: shape plus a sha256 over the labels and the first binned
+    rows. Guards the hands-off env-var mode, where a still-exported
+    ``LIGHTGBM_TPU_CHECKPOINT`` plus a second experiment on different
+    data of the same shape would otherwise silently continue the first
+    run's trees. Hashed once per engine (the data is immutable during
+    training), so per-snapshot cost is a dict lookup."""
+    cached = getattr(eng, "_ckpt_data_fp", None)
+    if cached is not None:
+        return cached
+    import hashlib
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(
+        np.asarray(eng.train_set.get_label(), np.float64)).tobytes())
+    h.update(np.ascontiguousarray(
+        eng.train_set.host_bins()[:64]).tobytes())
+    fp = {"n": int(eng.n), "F": int(eng.F), "K": int(eng.K),
+          "digest": h.hexdigest()}
+    eng._ckpt_data_fp = fp
+    return fp
+
+
+#: params whose drift between write and resume is expected and benign
+#: (the resume target legitimately differs; IO paths don't shape the
+#: model)
+_FINGERPRINT_IGNORE = {"num_iterations", "input_model", "output_model",
+                       "snapshot_freq", "data", "valid", "output_result"}
+
+
+def _params_fingerprint(params) -> Dict[str, str]:
+    from ..config import resolve_params
+    return {str(k): str(v) for k, v in
+            sorted(resolve_params(params or {}).items())
+            if k not in _FINGERPRINT_IGNORE}
+
+
+def _prune(directory: str, keep: int) -> None:
+    if keep is None or keep <= 0:
+        return
+    snaps = sorted(_snapshot_files(directory))
+    for _, name in snaps[:-keep]:
+        try:
+            os.unlink(os.path.join(directory, name))
+        except OSError:
+            pass
+
+
+def _snapshot_files(directory: str):
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _FILE_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), name))
+    return out
+
+
+# ---------------------------------------------------------------------
+# read
+# ---------------------------------------------------------------------
+
+def load_snapshot(path: str) -> Dict[str, Any]:
+    """Load + validate one snapshot. Raises :class:`CheckpointError`
+    on anything short of a complete, well-formed file."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            files = set(z.files)
+            required = {"state_json", "model_str", "score",
+                        "frng_keys", "drng_keys"}
+            missing = required - files
+            if missing:
+                raise CheckpointError(
+                    f"{path}: missing members {sorted(missing)}")
+            state = json.loads(bytes(z["state_json"]).decode("utf-8"))
+            if state.get("magic") != CHECKPOINT_MAGIC:
+                raise CheckpointError(f"{path}: bad magic "
+                                      f"{state.get('magic')!r}")
+            snap = dict(state)
+            snap["model_str"] = bytes(z["model_str"]).decode("utf-8")
+            snap["score"] = np.asarray(z["score"], np.float32)
+            snap["frng_keys"] = np.asarray(z["frng_keys"], np.uint32)
+            snap["drng_keys"] = np.asarray(z["drng_keys"], np.uint32)
+    except CheckpointError:
+        raise
+    except Exception as e:  # zip/json/np errors: corrupt or foreign file
+        raise CheckpointError(f"{path}: unreadable snapshot ({e})") from e
+    if snap["score"].ndim != 2:
+        raise CheckpointError(f"{path}: score must be [K, n]")
+    snap["path"] = path
+    return snap
+
+
+def load_latest_snapshot(directory: str) -> Optional[Dict[str, Any]]:
+    """Newest snapshot in ``directory`` that validates; corrupted or
+    truncated files are skipped (with a warning) in favor of the
+    previous one. None when the directory holds no usable snapshot."""
+    directory = os.fspath(directory)
+    for _, name in sorted(_snapshot_files(directory), reverse=True):
+        path = os.path.join(directory, name)
+        try:
+            return load_snapshot(path)
+        except CheckpointError as e:
+            log_warning(f"checkpoint: skipping invalid snapshot: {e}")
+    return None
+
+
+def list_snapshots(directory: str) -> List[Dict[str, Any]]:
+    """Every ``ckpt_*.npz`` in ``directory`` with validation status —
+    the ``lightgbm_tpu checkpoints <dir>`` inspection surface."""
+    out = []
+    directory = os.fspath(directory)
+    for it, name in sorted(_snapshot_files(directory)):
+        path = os.path.join(directory, name)
+        row: Dict[str, Any] = {
+            "path": path, "iteration": it,
+            "bytes": os.path.getsize(path),
+            "mtime": os.path.getmtime(path),
+        }
+        try:
+            snap = load_snapshot(path)
+            row.update(status="ok", num_trees=snap["num_trees"],
+                       best_iteration=snap["best_iteration"])
+        except CheckpointError as e:
+            row.update(status="corrupt", error=str(e))
+        out.append(row)
+    return out
+
+
+# ---------------------------------------------------------------------
+# restore
+# ---------------------------------------------------------------------
+
+def restore_booster(booster, snap: Dict[str, Any]) -> int:
+    """Install a snapshot into a freshly-built training Booster and
+    return the iteration to continue from."""
+    from ..basic import Booster, LightGBMError
+
+    eng = booster._engine
+    if eng is None:
+        raise LightGBMError("restore requires a Booster built with a "
+                            "train_set")
+    fp_now = _params_fingerprint(booster.params)
+    fp_then = snap.get("params_fingerprint") or {}
+    drift = {k for k in set(fp_now) | set(fp_then)
+             if fp_now.get(k) != fp_then.get(k)}
+    if drift:
+        log_warning(
+            "checkpoint: resuming with different parameters than the "
+            f"snapshot was written with ({', '.join(sorted(drift))}); "
+            "the resumed model will not match an uninterrupted run")
+    fp_data = snap.get("data_fingerprint")
+    if fp_data is not None and fp_data != _dataset_fingerprint(eng):
+        raise LightGBMError(
+            f"checkpoint {snap.get('path')} was written against "
+            "different training data (label/bin fingerprint mismatch) "
+            "— refusing to silently continue another run's trees. "
+            "Point resume_from/LIGHTGBM_TPU_CHECKPOINT at a fresh "
+            "directory for this dataset.")
+    parsed = Booster(model_str=snap["model_str"])
+    trees = parsed._trees
+    if len(trees) != int(snap["num_trees"]):
+        raise LightGBMError(
+            f"checkpoint {snap.get('path')}: model text holds "
+            f"{len(trees)} trees, state says {snap['num_trees']}")
+    score = np.asarray(snap["score"], np.float32)
+    if score.shape != (eng.K, eng.n):
+        raise LightGBMError(
+            f"checkpoint {snap.get('path')}: score shape {score.shape} "
+            f"does not match this training set [{eng.K}, {eng.n}] — "
+            "was the checkpoint written against different data?")
+    eng.preload_models(trees, score=score)
+    eng._resume_stalled = bool(snap.get("stalled", False))
+    eng._tree_weights = [float(w) for w in snap.get("tree_weights", [])] \
+        or [1.0] * len(trees)
+    _rng_restore(eng._feature_rng, snap["frng_keys"], snap["feature_rng"])
+    _rng_restore(eng._dart_rng, snap["drng_keys"], snap["dart_rng"])
+    _rewarm_bagging_cache(eng, int(snap["iteration"]))
+    booster.best_iteration = int(snap.get("best_iteration", -1))
+    booster.best_score = {
+        d: dict(sub) for d, sub in (snap.get("best_score") or {}).items()}
+    booster._attrs = dict(snap.get("attrs") or {})
+    booster._train_data_name = snap.get("train_data_name",
+                                        booster._train_data_name)
+    return int(snap["iteration"])
+
+
+def _rewarm_bagging_cache(eng, iteration: int) -> None:
+    """Re-derive the cached bagging weights an uninterrupted run would
+    be holding at ``iteration``: the draw from the last refresh
+    iteration (``_row_weights`` reuses it until the next refresh)."""
+    cfg = eng.cfg
+    bag_active = cfg.bagging_freq > 0 and (
+        cfg.bagging_fraction < 1.0 or cfg.pos_bagging_fraction < 1.0
+        or cfg.neg_bagging_fraction < 1.0)
+    if not bag_active or iteration <= 0 \
+            or cfg.data_sample_strategy == "goss":
+        return
+    last_refresh = (iteration // cfg.bagging_freq) * cfg.bagging_freq
+    if last_refresh >= iteration:
+        return  # next iteration draws fresh anyway
+    eng._cached_bag = None
+    eng._row_weights(last_refresh, None, None)
+
+
+# ---------------------------------------------------------------------
+# callback
+# ---------------------------------------------------------------------
+
+@dataclass(eq=False)
+class Checkpoint:
+    """Periodic atomic snapshot callback (after-iteration, order 50 so
+    the iteration's telemetry event lands first)."""
+    directory: str
+    every_n_iters: int = 1
+    keep: int = 3
+    order: int = 50
+    before_iteration: bool = False
+    _warned_unsupported: bool = False
+
+    def __call__(self, env) -> None:
+        eng = getattr(env.model, "_engine", None)
+        if eng is None:
+            if not self._warned_unsupported:
+                self._warned_unsupported = True
+                log_warning("checkpoint: cv()/CVBooster checkpointing is "
+                            "not supported; callback disabled")
+            return
+        it = int(eng.iter_)
+        last = env.iteration + 1 >= env.end_iteration
+        if it <= 0 or (not last and self.every_n_iters > 1
+                       and it % self.every_n_iters != 0):
+            return
+        # under multi-process SPMD every rank holds the identical
+        # replicated model: verify that before rank 0 writes for all
+        try:
+            import jax
+            nproc, rank = jax.process_count(), jax.process_index()
+        except Exception:
+            nproc, rank = 1, 0
+        if nproc > 1:
+            from ..parallel.spmd import verify_step_consistency
+            verify_step_consistency(
+                it, len(eng._models_store) + len(eng._pending_dev))
+            if rank != 0:
+                return
+        path = write_snapshot(self.directory, env.model, keep=self.keep)
+        log_info(f"checkpoint: wrote {path}")
+
+
+def checkpoint(directory: str, every_n_iters: int = 1,
+               keep: int = 3) -> Checkpoint:
+    """Create the checkpoint callback: atomically snapshot the model
+    and training state into ``directory`` every ``every_n_iters``
+    boosting iterations (and at the final one), retaining the ``keep``
+    newest snapshots. Pair with ``train(..., resume_from=directory)``
+    or ``LIGHTGBM_TPU_CHECKPOINT=<directory>`` to survive crashes."""
+    if every_n_iters <= 0:
+        raise ValueError("every_n_iters must be positive")
+    return Checkpoint(directory=os.fspath(directory),
+                      every_n_iters=int(every_n_iters), keep=int(keep))

@@ -1,0 +1,117 @@
+"""Deterministic fault injection + device-error classification.
+
+The test harness behind every resilience path: the
+``LIGHTGBM_TPU_FAULT_INJECT`` environment variable carries a
+comma-separated list of ``kind@iteration`` tokens, e.g.::
+
+    LIGHTGBM_TPU_FAULT_INJECT=nan_grad@7,oom@3,kill@12
+
+Kinds:
+
+- ``nan_grad@N`` / ``nan_hess@N`` — poison the iteration-``N`` gradient
+  / hessian vectors with NaN before the tree is grown, exercising the
+  non-finite guard (``nonfinite_policy``). Inside the fused jitted step
+  the poisoning is traced as a ``where(it == N, ...)`` so the program
+  stays a single dispatch.
+- ``oom@N`` — raise a synthetic ``RESOURCE_EXHAUSTED`` runtime error at
+  the iteration-``N`` grow dispatch. The token is *consumed* on firing,
+  so the degradation retry path succeeds (one ``oom@N`` = one transient
+  OOM). Repeat the token to simulate back-to-back exhaustion.
+- ``kill@N`` — ``SIGKILL`` the current process at the *start* of
+  iteration ``N``, exercising checkpoint/auto-resume end to end.
+
+A missing / empty variable parses to an inert plan: every query is a
+cheap tuple-membership test, nothing touches jax, and production runs
+pay nothing.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Dict, List, Tuple
+
+__all__ = ["FaultPlan", "InjectedResourceExhausted", "is_resource_exhausted"]
+
+_KNOWN_KINDS = ("nan_grad", "nan_hess", "oom", "kill")
+
+
+class InjectedResourceExhausted(RuntimeError):
+    """Synthetic stand-in for jaxlib's ``XlaRuntimeError`` OOM: carries
+    the same ``RESOURCE_EXHAUSTED`` marker the classifier keys on."""
+
+
+def is_resource_exhausted(exc: BaseException) -> bool:
+    """True for XLA allocation failures (``XlaRuntimeError`` with a
+    RESOURCE_EXHAUSTED status, allocator "out of memory" messages) and
+    their injected stand-ins. Message-based on purpose: the concrete
+    exception class moved across jaxlib versions."""
+    msg = str(exc)
+    return ("RESOURCE_EXHAUSTED" in msg
+            or "Out of memory" in msg
+            or "out of memory" in msg)
+
+
+class FaultPlan:
+    """Parsed ``kind@iteration`` schedule with consume-on-fire
+    semantics for ``oom`` (so a retry after degradation succeeds)."""
+
+    def __init__(self, spec: str = ""):
+        self._events: Dict[str, List[int]] = {}
+        for token in (spec or "").split(","):
+            token = token.strip()
+            if not token:
+                continue
+            if "@" not in token:
+                raise ValueError(
+                    f"bad fault-injection token {token!r} "
+                    "(expected kind@iteration)")
+            kind, it = token.split("@", 1)
+            kind = kind.strip()
+            if kind not in _KNOWN_KINDS:
+                raise ValueError(
+                    f"unknown fault-injection kind {kind!r} "
+                    f"(known: {', '.join(_KNOWN_KINDS)})")
+            self._events.setdefault(kind, []).append(int(it))
+        for lst in self._events.values():
+            lst.sort()
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        return cls(os.environ.get("LIGHTGBM_TPU_FAULT_INJECT", ""))
+
+    @property
+    def active(self) -> bool:
+        return bool(self._events)
+
+    def iters(self, kind: str) -> Tuple[int, ...]:
+        """All scheduled iterations for ``kind`` (non-consuming; the
+        fused step bakes these into the traced program)."""
+        return tuple(self._events.get(kind, ()))
+
+    def fires(self, kind: str, iteration: int) -> bool:
+        """Non-consuming membership test (nan_grad / nan_hess)."""
+        return iteration in self._events.get(kind, ())
+
+    def take(self, kind: str, iteration: int) -> bool:
+        """Consuming test: True once per scheduled token."""
+        lst = self._events.get(kind)
+        if lst and iteration in lst:
+            lst.remove(iteration)
+            return True
+        return False
+
+    def maybe_oom(self, iteration: int) -> None:
+        """Raise one synthetic RESOURCE_EXHAUSTED if armed for this
+        iteration (consumed, so the caller's retry proceeds)."""
+        if self.take("oom", iteration):
+            raise InjectedResourceExhausted(
+                f"RESOURCE_EXHAUSTED: injected device OOM at iteration "
+                f"{iteration} (LIGHTGBM_TPU_FAULT_INJECT)")
+
+    def maybe_kill(self, iteration: int) -> None:
+        """SIGKILL this process if armed for this iteration — no
+        cleanup, no atexit: the hard-crash the checkpoint layer must
+        survive."""
+        if self.take("kill", iteration):
+            os.kill(os.getpid(), signal.SIGKILL)

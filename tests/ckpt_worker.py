@@ -1,0 +1,57 @@
+"""Subprocess worker for the checkpoint kill/resume tests.
+
+Usage: python ckpt_worker.py <model_out>
+
+Trains a fixed deterministic 20-round regression model on the CPU
+backend. All resilience wiring comes from the environment the parent
+test sets:
+
+- ``LIGHTGBM_TPU_CHECKPOINT=<dir>`` — auto-checkpoint every iteration
+  AND auto-resume from the newest valid snapshot,
+- ``LIGHTGBM_TPU_FAULT_INJECT=kill@N`` — SIGKILL mid-train (the run
+  the parent expects to die with -SIGKILL).
+
+On completion the model is saved to ``<model_out>`` and ``WORKER DONE``
+is printed; the parent compares the saved model byte-for-byte against
+an uninterrupted run.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+NUM_ROUNDS = 20
+PARAMS = {
+    "objective": "regression", "num_leaves": 7, "verbosity": -1,
+    "min_data_in_leaf": 5, "bagging_fraction": 0.7, "bagging_freq": 3,
+    "feature_fraction": 0.8, "seed": 11,
+}
+
+
+def make_data():
+    rs = np.random.RandomState(4)
+    X = rs.randn(800, 8)
+    y = X @ rs.randn(8) + 0.1 * rs.randn(800)
+    return X, y
+
+
+def main() -> int:
+    model_out = sys.argv[1]
+    X, y = make_data()
+    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y),
+                    num_boost_round=NUM_ROUNDS)
+    bst.save_model(model_out)
+    print(f"WORKER DONE iterations={bst.current_iteration()}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,88 @@ def test_no_eager_lax_loops_in_boosting_path():
         + "\n  ".join(offenders))
 
 
+def _function_node(tree, qualpath):
+    """Find a (possibly nested) FunctionDef by ['outer', 'inner'] path."""
+    nodes = [tree]
+    for name in qualpath:
+        found = None
+        for node in nodes:
+            for child in ast.walk(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) \
+                        and child.name == name:
+                    found = child
+                    break
+            if found is not None:
+                break
+        assert found is not None, f"function {'.'.join(qualpath)} not found"
+        nodes = [found]
+    return nodes[0]
+
+
+def test_nonfinite_guard_stays_inside_jitted_step():
+    """The resilience guard contract (docs/RESILIENCE.md): the
+    non-finite check on gradients/hessians/leaf values must live INSIDE
+    the fused jitted step (one fused reduction), and the fused
+    iteration wrapper must not grow an eager per-iteration host fetch
+    (np.asarray / device_get / block_until_ready) — that would
+    serialize the device pipeline, the exact regression class the lint
+    above guards against."""
+    path = os.path.join(PKG, "models", "gbdt.py")
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+
+    # (1) guard fused into the traced program: `step` (the body jitted
+    # by _get_fused_fn) must trace the guard — either inline isfinite
+    # reductions or calls into the shared pure-jnp guard helpers
+    # (_gh_flag_clamp / _leaf_guard), which themselves must reduce via
+    # isfinite
+    guard_helpers = {"_gh_flag_clamp", "_leaf_guard"}
+
+    def _calls(fn_node):
+        names = set()
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Call):
+                if isinstance(n.func, ast.Attribute):
+                    names.add(n.func.attr)
+                elif isinstance(n.func, ast.Name):
+                    names.add(n.func.id)
+        return names
+
+    step = _function_node(tree, ["_get_fused_fn", "step"])
+    step_calls = _calls(step)
+    assert "isfinite" in step_calls or (step_calls & guard_helpers), (
+        "the non-finite guard left the fused jitted step: "
+        "_get_fused_fn.step must trace jnp.isfinite (directly or via "
+        "_gh_flag_clamp/_leaf_guard), not check eagerly")
+    for helper in guard_helpers & step_calls:
+        node = _function_node(tree, [helper])
+        assert "isfinite" in _calls(node), (
+            f"{helper} no longer reduces via jnp.isfinite — the fused "
+            "guard is gone")
+
+    # (2) no host materialization in the fused iteration driver: the
+    # guard flag must travel through the async one-iteration-late queue
+    fused = _function_node(tree, ["_train_one_iter_fused"])
+    offenders = []
+    for n in ast.walk(fused):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)):
+            continue
+        attr = n.func.attr
+        base = n.func.value
+        if attr == "block_until_ready":
+            offenders.append(f"line {n.lineno}: .block_until_ready()")
+        elif isinstance(base, ast.Name) and (base.id, attr) in (
+                ("np", "asarray"), ("jax", "device_get"),
+                ("np", "array")):
+            offenders.append(f"line {n.lineno}: {base.id}.{attr}()")
+    assert not offenders, (
+        "eager host fetch in _train_one_iter_fused (guard/fault flags "
+        "must use the async _push_guard_flags queue):\n  "
+        + "\n  ".join(offenders))
+
+
 def test_allowlist_entries_still_exist():
     """A renamed/deleted function must be pruned from the allowlist —
     stale entries would silently stop guarding anything."""

@@ -11,6 +11,7 @@ learner (models/gbdt.py).
 """
 
 import os
+import signal
 import socket
 import subprocess
 import sys
@@ -31,6 +32,37 @@ def _free_port():
     return port
 
 
+def _kill_group(proc) -> None:
+    """SIGKILL a worker's whole process group (workers run in their own
+    session); fall back to killing the process alone."""
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+
+def _drain_all(procs, reason: str):
+    """Kill every worker group and fail with their partial output —
+    a hung collective must not leak orphan workers into the tier-1
+    budget, and the partial logs are the only diagnostic there is."""
+    for q in procs:
+        _kill_group(q)
+    partials = []
+    for rank, q in enumerate(procs):
+        try:
+            out, _ = q.communicate(timeout=30)
+        except Exception:
+            out = b""
+        partials.append(f"--- rank {rank} partial output "
+                        f"(returncode {q.returncode}) ---\n"
+                        f"{out.decode(errors='replace')}")
+    pytest.fail(reason + "; killed worker process groups.\n"
+                + "\n".join(partials))
+
+
 @pytest.mark.timeout(600)
 def test_two_process_data_parallel_matches_single_process(tmp_path):
     port = _free_port()
@@ -41,12 +73,17 @@ def test_two_process_data_parallel_matches_single_process(tmp_path):
         subprocess.Popen(
             [sys.executable, os.path.join(_DIR, "spmd_worker.py"),
              str(rank), str(port), str(tmp_path)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)
         for rank in (0, 1)
     ]
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=540)
+        try:
+            out, _ = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            _drain_all(procs, "SPMD workers timed out after 540 s "
+                              "(stuck collective?)")
         outs.append(out.decode())
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"
