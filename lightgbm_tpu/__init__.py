@@ -6,17 +6,34 @@ histogram-based leaf-wise GBDT with the binned data, gradients and
 histograms resident in HBM; collectives over a `jax.sharding.Mesh`
 instead of sockets/MPI; and a drop-in `Dataset`/`Booster`/`train` Python
 API mirroring the reference python-package.
+
+Importing this package is LAZY (PEP 562): the training stack — and
+with it jax — only loads when a training/data symbol is first touched.
+That keeps jax-free tools runnable anywhere: ``python -m lightgbm_tpu
+lint`` (the tpulint static analyzer, docs/STATIC_ANALYSIS.md) must work
+in environments that cannot initialize any jax backend at all.
 """
 
-from .basic import Booster, Dataset, LightGBMError, Sequence
-from .callback import (EarlyStopException, checkpoint, early_stopping,
-                       log_evaluation, record_evaluation, reset_parameter,
-                       telemetry)
-from .config import Config
-from .engine import CVBooster, cv, train
-from .utils.log import register_logger
-
 __version__ = "0.1.0"
+
+# symbol -> providing submodule; resolved on first attribute access
+_LAZY = {
+    "Booster": "basic", "Dataset": "basic", "LightGBMError": "basic",
+    "Sequence": "basic",
+    "EarlyStopException": "callback", "checkpoint": "callback",
+    "early_stopping": "callback", "log_evaluation": "callback",
+    "record_evaluation": "callback", "reset_parameter": "callback",
+    "telemetry": "callback",
+    "Config": "config",
+    "CVBooster": "engine", "cv": "engine", "train": "engine",
+    "register_logger": "utils.log",
+    # optional extras (sklearn / plotting deps may be absent)
+    "LGBMModel": "sklearn", "LGBMClassifier": "sklearn",
+    "LGBMRegressor": "sklearn", "LGBMRanker": "sklearn",
+    "plot_importance": "plotting", "plot_metric": "plotting",
+    "plot_split_value_histogram": "plotting", "plot_tree": "plotting",
+    "create_tree_digraph": "plotting",
+}
 
 __all__ = [
     "Dataset", "Booster", "CVBooster", "LightGBMError",
@@ -24,23 +41,40 @@ __all__ = [
     "early_stopping", "log_evaluation", "record_evaluation",
     "reset_parameter", "telemetry", "checkpoint", "EarlyStopException",
     "register_logger", "Config",
+    "LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker",
+    "plot_importance", "plot_metric", "plot_split_value_histogram",
+    "plot_tree", "create_tree_digraph",
 ]
 
-try:  # sklearn-style wrappers are optional (need scikit-learn)
-    from .sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
-                          LGBMRegressor)
-    __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor",
-                "LGBMRanker"]
-except ImportError:  # pragma: no cover
-    pass
 
-try:
-    from . import plotting
-    from .plotting import (create_tree_digraph, plot_importance,
-                           plot_metric, plot_split_value_histogram,
-                           plot_tree)
-    __all__ += ["plot_importance", "plot_metric",
-                "plot_split_value_histogram", "plot_tree",
-                "create_tree_digraph"]
-except ImportError:  # pragma: no cover
-    pass
+# submodules reachable as attributes (`lightgbm_tpu.basic`, ...) — the
+# eager __init__ used to bind these as an import side effect
+_SUBMODULES = {
+    "analysis", "basic", "callback", "cli", "config", "convert",
+    "engine", "metrics", "models", "objectives", "obs", "ops",
+    "parallel", "plotting", "prediction", "ranking", "resilience",
+    "shap", "sklearn", "utils",
+}
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None and name not in _SUBMODULES:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    try:
+        mod = importlib.import_module(f".{target or name}", __name__)
+    except ImportError as e:
+        # optional extras: surface as the AttributeError the import
+        # protocol expects, with the real cause chained
+        raise AttributeError(
+            f"{name} is unavailable: importing "
+            f"{__name__}.{target or name} failed ({e})") from e
+    value = getattr(mod, name) if target is not None else mod
+    globals()[name] = value  # cache: __getattr__ runs once per symbol
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

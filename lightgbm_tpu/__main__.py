@@ -1,4 +1,13 @@
-from .cli import main
+import sys
 
 if __name__ == "__main__":
+    # `lint` runs the jax-free static analyzer (lightgbm_tpu/analysis/);
+    # dispatch it BEFORE importing the training CLI, whose module
+    # imports pull in jax — tpulint must work where no backend can
+    # initialize.
+    if len(sys.argv) > 1 and sys.argv[1] == "lint":
+        from .analysis.cli import main as lint_main
+        raise SystemExit(lint_main(sys.argv[2:]))
+
+    from .cli import main
     raise SystemExit(main())

@@ -1,0 +1,98 @@
+"""``python -m lightgbm_tpu lint`` — the tpulint CLI.
+
+Deliberately importable (and runnable) WITHOUT jax: the dispatcher in
+``lightgbm_tpu/__main__.py`` routes ``lint`` here before the training
+CLI (and its jax import) ever loads, so the analyzer runs in
+environments that cannot initialize a backend at all (CI formatters,
+pre-commit hooks, docs builds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+EXIT_CODES = """\
+exit codes:
+  0  clean: no findings outside the baseline
+  1  findings (or stale/unjustified baseline entries with --strict)
+  2  usage or internal error
+"""
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu lint",
+        description=(
+            "tpulint: JAX/TPU-aware static analyzer for the boosting "
+            "hot path. Builds a cross-module call graph, computes "
+            "jit-reachability (which functions are only ever entered "
+            "through a jax.jit/pjit/shard_map wrapper), and checks "
+            "the hazard catalog TPL001-TPL006 (eager lax loops, host "
+            "syncs, recompile storms, donation violations, "
+            "order-unstable iteration, locks across dispatch). "
+            "See docs/STATIC_ANALYSIS.md."),
+        epilog=EXIT_CODES,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--format", choices=("text", "json"), default="text",
+                   help="output format (default: text)")
+    p.add_argument("--baseline", metavar="FILE", default=None,
+                   help="accepted-findings file (default: "
+                        "tools/tpulint_baseline.txt when present; "
+                        "pass an empty string to disable)")
+    p.add_argument("--rule", metavar="TPLNNN", action="append",
+                   default=None,
+                   help="run only this rule (repeatable); default: "
+                        "TPL001-TPL006")
+    p.add_argument("--root", metavar="DIR", default=None,
+                   help="package directory to analyze (default: the "
+                        "installed lightgbm_tpu package)")
+    p.add_argument("--write-baseline", metavar="FILE", default=None,
+                   help="write ALL current findings to FILE as a "
+                        "baseline skeleton (justifications left as "
+                        "TODOs) and exit 0")
+    p.add_argument("--strict", action="store_true",
+                   help="also fail (exit 1) on stale or unjustified "
+                        "baseline entries")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(
+        sys.argv[1:] if argv is None else argv)
+    if args.write_baseline and args.rule:
+        # a rule-filtered run sees only a slice of the findings;
+        # writing it out would silently drop every other rule's
+        # accepted entries (and their justifications)
+        print("tpulint: error: --write-baseline requires a full run "
+              "(drop --rule)", file=sys.stderr)
+        return 2
+    from .engine import run_lint
+    try:
+        result = run_lint(root=args.root, rules=args.rule,
+                          baseline_path=args.baseline)
+    except (ValueError, OSError, SyntaxError) as e:
+        print(f"tpulint: error: {e}", file=sys.stderr)
+        return 2
+    if args.write_baseline:
+        result.write_baseline(args.write_baseline)
+        print(f"tpulint: wrote {len(result.findings) + len(result.baselined)} "
+              f"entries to {args.write_baseline}")
+        return 0
+    if args.format == "json":
+        from .report import render_json
+        print(render_json(result))
+    else:
+        from .report import render_text
+        print(render_text(result))
+    if result.findings:
+        return 1
+    if args.strict and (result.stale_baseline
+                        or result.unjustified_baseline):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,149 @@
+"""Lint engine: scan -> callgraph -> rules -> baseline filter.
+
+``run_lint`` is the one library entry point; the CLI
+(:mod:`~lightgbm_tpu.analysis.cli`) and the tier-1 test
+(tests/test_static_analysis.py) are thin layers over it. Pure stdlib —
+no jax import anywhere on this path.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Set
+
+from .baseline import (BaselineEntry, assign_ids, format_baseline,
+                       load_baseline)
+from .callgraph import CallGraph, scan_package
+from .rules import ALL_RULES, Finding, LintContext, rule_by_id
+
+__all__ = ["run_lint", "LintResult", "default_scope", "package_root",
+           "default_baseline_path"]
+
+#: rule scope: the boosting hot path (ISSUE scope floor: models/,
+#: ops/, parallel/, engine.py, resilience/ — plus obs/ for TPL006 and
+#: the per-iteration device-code modules at package root).
+_SCOPE_DIRS = ("models/", "ops/", "parallel/", "resilience/", "obs/")
+_SCOPE_FILES = ("engine.py", "ranking.py", "prediction.py",
+                "metrics.py", "objectives.py", "shap.py")
+
+
+def package_root() -> str:
+    """Directory of the ``lightgbm_tpu`` package being analyzed."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_baseline_path(root: Optional[str] = None) -> str:
+    root = root or package_root()
+    return os.path.join(os.path.dirname(root), "tools",
+                        "tpulint_baseline.txt")
+
+
+def default_scope(relpaths: Sequence[str]) -> Set[str]:
+    out = set()
+    for rel in relpaths:
+        if rel in _SCOPE_FILES or rel.startswith(_SCOPE_DIRS):
+            out.add(rel)
+    return out
+
+
+@dataclass
+class LintResult:
+    findings: List[Finding]                  # non-baselined, sorted
+    baselined: List[Finding]
+    stale_baseline: List[BaselineEntry]
+    suppressed: List[Finding]                # pragma-disabled
+    files: Set[str]
+    graph: CallGraph
+    elapsed: float
+    unjustified_baseline: List[BaselineEntry] = field(
+        default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def write_baseline(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(format_baseline(self.findings + self.baselined))
+
+
+def run_lint(root: Optional[str] = None,
+             package: str = "lightgbm_tpu",
+             scope: Optional[Set[str]] = None,
+             rules: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = None,
+             files: Optional[List[str]] = None) -> LintResult:
+    """Run the analyzer.
+
+    Args:
+      root: package directory to scan (default: this installation's
+        ``lightgbm_tpu``). The whole package is always parsed for the
+        call graph; ``scope`` limits where rules REPORT.
+      scope: relpaths rules run over (default: the hot-path scope).
+      rules: rule ids to run (default: all).
+      baseline_path: accepted-findings file ("": no baseline;
+        None: tools/tpulint_baseline.txt when present).
+      files: restrict parsing to these package-relative files
+        (fixture tests use this).
+    """
+    t0 = time.perf_counter()
+    root = root or package_root()
+    scans = scan_package(root, package=package, files=files)
+    graph = CallGraph(scans)
+    relpaths = [s.relpath for s in scans]
+    if scope is None:
+        scope = default_scope(relpaths) if files is None else \
+            set(relpaths)
+    ctx = LintContext(graph=graph, scans=graph.scans, scope=scope)
+
+    active = ALL_RULES
+    if rules:
+        wanted = []
+        for rid in rules:
+            rule = rule_by_id(rid)
+            if rule is None:
+                raise ValueError(
+                    f"unknown rule {rid!r} (have: "
+                    f"{', '.join(r.id for r in ALL_RULES)})")
+            wanted.append(rule)
+        active = wanted
+
+    findings: List[Finding] = []
+    suppressed: List[Finding] = []
+    for rule in active:
+        for f in rule.run(ctx):
+            scan = graph.scans.get(f.relpath)
+            if scan is not None and scan.suppressed(f.rule, f.lineno):
+                suppressed.append(f)
+            else:
+                findings.append(f)
+    assign_ids(findings + suppressed)
+
+    if baseline_path is None:
+        cand = default_baseline_path(root)
+        baseline_path = cand if os.path.exists(cand) else ""
+    entries = load_baseline(baseline_path) if baseline_path else []
+    by_fid = {e.fid: e for e in entries}
+    kept: List[Finding] = []
+    baselined: List[Finding] = []
+    seen_fids = set()
+    for f in findings:
+        seen_fids.add(f.fid)
+        (baselined if f.fid in by_fid else kept).append(f)
+    # staleness is only decidable for rules that actually ran: a
+    # --rule-filtered invocation must not report (or --strict-fail on)
+    # other rules' perfectly valid baseline entries
+    active_ids = {r.id for r in active}
+    stale = [e for e in entries
+             if e.fid not in seen_fids
+             and e.fid.split(":", 1)[0] in active_ids]
+    unjustified = [e for e in entries if not e.justification]
+    kept.sort(key=lambda f: f.sort_key())
+    baselined.sort(key=lambda f: f.sort_key())
+    return LintResult(findings=kept, baselined=baselined,
+                      stale_baseline=stale, suppressed=suppressed,
+                      files=set(relpaths) & scope, graph=graph,
+                      elapsed=time.perf_counter() - t0,
+                      unjustified_baseline=unjustified)

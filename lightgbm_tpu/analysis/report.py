@@ -1,0 +1,49 @@
+"""Finding renderers: human text and machine JSON."""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+__all__ = ["render_text", "render_json"]
+
+
+def render_text(result) -> str:
+    lines: List[str] = []
+    for f in result.findings:
+        lines.append(f"{f.relpath}:{f.lineno}:{f.col + 1}: "
+                     f"{f.rule} [{f.fid}]")
+        lines.append(f"    {f.message}")
+    if result.stale_baseline:
+        lines.append("")
+        lines.append("stale baseline entries (finding no longer "
+                     "occurs — delete them):")
+        for e in result.stale_baseline:
+            lines.append(f"    {e.fid}")
+    n = len(result.findings)
+    b = len(result.baselined)
+    lines.append("")
+    lines.append(
+        f"tpulint: {n} finding{'s' if n != 1 else ''}"
+        + (f" ({b} baselined and suppressed)" if b else "")
+        + f", {len(result.files)} files, "
+        f"{len(result.graph.jit_reachable)} jit-reachable functions, "
+        f"{result.elapsed:.2f}s")
+    return "\n".join(lines)
+
+
+def render_json(result) -> str:
+    def fdict(f):
+        return {"id": f.fid, "rule": f.rule, "path": f.relpath,
+                "line": f.lineno, "col": f.col + 1, "function": f.func,
+                "symbol": f.symbol, "message": f.message}
+
+    return json.dumps({
+        "findings": [fdict(f) for f in result.findings],
+        "baselined": [fdict(f) for f in result.baselined],
+        "stale_baseline": [e.fid for e in result.stale_baseline],
+        "files": sorted(result.files),
+        "jit_reachable": sorted(
+            f"{p}:{q}" for (p, q) in result.graph.jit_reachable),
+        "elapsed_seconds": result.elapsed,
+    }, indent=2, sort_keys=False)

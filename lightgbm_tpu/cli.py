@@ -10,6 +10,7 @@ Usage:
     python -m lightgbm_tpu task=train data=train.csv objective=binary
     python -m lightgbm_tpu stats run.jsonl     # summarize telemetry
     python -m lightgbm_tpu checkpoints <dir>   # inspect snapshots
+    python -m lightgbm_tpu lint [--help]       # tpulint static analyzer
 
 Config-file syntax matches the reference (application.cpp:50-86 +
 config.cpp KV2Map): one ``key = value`` per line, ``#`` comments;
@@ -173,10 +174,40 @@ def _task_save_binary(cfg: Config, params: Dict[str, Any]) -> None:
     log_info(f"Binned dataset saved to {out}")
 
 
+_STATS_HELP = """\
+usage: python -m lightgbm_tpu stats <file.jsonl>
+
+Fold a telemetry event stream (lightgbm_tpu.telemetry(path) callback /
+LIGHTGBM_TPU_TELEMETRY=<path>) into the sorted per-phase summary table:
+wall time, recompiles, peak HBM, fault events, final evals, and a
+per-phase total/count/mean/percent/skew breakdown. See
+docs/OBSERVABILITY.md.
+
+exit codes:
+  0  summary printed
+  1  unreadable/malformed file, or no iteration events in it
+"""
+
+_CHECKPOINTS_HELP = """\
+usage: python -m lightgbm_tpu checkpoints <dir>
+
+List every snapshot the resilience checkpoint callback wrote into a
+directory, with validation status — the operator view for "can this run
+resume, and from which iteration?". See docs/RESILIENCE.md.
+
+exit codes:
+  0  at least one valid (resumable) snapshot listed
+  1  not a directory, no snapshots, or no valid snapshot
+"""
+
+
 def _task_stats(argv: List[str]) -> int:
     """``lightgbm_tpu stats <file.jsonl>``: fold a telemetry event
     stream (callback.telemetry / LIGHTGBM_TPU_TELEMETRY) into the
     sorted per-phase summary table."""
+    if argv and argv[0] in ("-h", "--help"):
+        print(_STATS_HELP)
+        return 0
     if not argv:
         print("usage: python -m lightgbm_tpu stats <file.jsonl>",
               file=sys.stderr)
@@ -206,6 +237,9 @@ def _task_checkpoints(argv: List[str]) -> int:
     resilience checkpoint callback wrote into a directory, with
     validation status — the operator view for "can this run resume,
     and from which iteration?"."""
+    if argv and argv[0] in ("-h", "--help"):
+        print(_CHECKPOINTS_HELP)
+        return 0
     if not argv:
         print("usage: python -m lightgbm_tpu checkpoints <dir>",
               file=sys.stderr)
@@ -268,6 +302,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         return _task_stats(argv[1:])
     if argv[0] == "checkpoints":
         return _task_checkpoints(argv[1:])
+    if argv[0] == "lint":
+        # normally dispatched jax-free in __main__.py before this
+        # module (and its jax imports) loads; kept here so programmatic
+        # main() callers get the same surface
+        from .analysis.cli import main as lint_main
+        return lint_main(argv[1:])
     try:
         params = parse_args(argv)
         cfg = Config.from_params(params)

@@ -157,9 +157,14 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
             every = 1
         callbacks.append(checkpoint(ckpt_env, every_n_iters=every))
     _setup_telemetry(callbacks, booster)
-    cbs_before = {cb for cb in callbacks
-                  if getattr(cb, "before_iteration", False)}
-    cbs_after = [cb for cb in callbacks if cb not in cbs_before]
+    # lists, not a set (tpulint TPL005): `sorted` is stable, so
+    # callbacks with EQUAL .order used to run in set hash order —
+    # varying per process (PYTHONHASHSEED) and across SPMD ranks.
+    # Registration order now breaks ties, like the cv() path.
+    cbs_before = [cb for cb in callbacks
+                  if getattr(cb, "before_iteration", False)]
+    cbs_after = [cb for cb in callbacks
+                 if not getattr(cb, "before_iteration", False)]
     cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
     cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
 

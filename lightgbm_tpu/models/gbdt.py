@@ -491,6 +491,7 @@ class GBDTBooster:
                 leaf_value=jnp.where(ok, lv, jnp.zeros_like(lv)))
         return dev_tree, flag
 
+    # tpulint: hot
     def _push_guard_flags(self, it: int, flags) -> None:
         """Queue a guard flag for the one-iteration-late async check
         (same non-stalling contract as the _nl_async tree queue)."""
@@ -518,6 +519,7 @@ class GBDTBooster:
                 "(nonfinite_policy=raise; use skip_tree or clamp to "
                 "train through transient numerical faults)")
 
+    # tpulint: hot
     def _drain_guard_flags(self) -> bool:
         """Resolve guard flags from previous async programs. A fired
         fault also sets the STICKY ``_fault_recent`` marker: callers
@@ -1158,6 +1160,7 @@ class GBDTBooster:
                                       jax.jit(step, donate_argnums=donate))
         return self._fused_fn
 
+    # tpulint: hot
     def _train_one_iter_fused(self) -> bool:
         """One boosting iteration as a single device program.
 
@@ -1214,6 +1217,7 @@ class GBDTBooster:
         self.iter_ += 1
         return False
 
+    # tpulint: hot
     def _defer_tree(self, vec, cmask, proto, num_leaves, shrink,
                     bias) -> None:
         """Queue one finished device tree for lazy host materialization
@@ -1230,6 +1234,7 @@ class GBDTBooster:
         self._tree_weights.append(1.0)
         self._nl_async.append(num_leaves)
 
+    # tpulint: hot
     def train_one_iter(self,
                        custom_grad: Optional[np.ndarray] = None,
                        custom_hess: Optional[np.ndarray] = None) -> bool:
@@ -1399,8 +1404,14 @@ class GBDTBooster:
                 # constant trees are recognized at flush time
                 num_leaves = 2
             else:
-                num_leaves = int(np.asarray(dev_tree.num_leaves))
-                sync_flag |= int(np.asarray(k_flag))
+                # ONE batched transfer, not two sequential blocking
+                # fetches (tpulint TPL002: each np.asarray scalar read
+                # is its own full device round trip on this
+                # latency-bound eager path)
+                nl_host, flag_host = jax.device_get(
+                    (dev_tree.num_leaves, k_flag))
+                num_leaves = int(nl_host)
+                sync_flag |= int(flag_host)
             if num_leaves <= 1:
                 # constant tree; carries the boost_from_average bias when
                 # it is the first iteration (gbdt.cpp models_.size() check /

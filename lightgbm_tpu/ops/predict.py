@@ -165,14 +165,11 @@ def predict_leaf_raw(tree: StackedTrees, ti: int | jnp.ndarray,
 
     return _traverse(n, decide, tree.left_child[ti], tree.right_child[ti])
 
-
-def predict_forest_raw(tree: StackedTrees, X: jnp.ndarray,
-                       num_trees: int) -> jnp.ndarray:
-    """Sum of leaf values over trees [0, num_trees) -> raw scores [n]."""
-
-    def body(i, acc):
-        leaves = predict_leaf_raw(tree, i, X)
-        return acc + tree.leaf_value[i][leaves]
-
-    init = jnp.zeros((X.shape[0],), tree.leaf_value.dtype)
-    return lax.fori_loop(0, num_trees, body, init)
+# NOTE: the old `predict_forest_raw` (a fori_loop-of-trees scorer) was
+# removed by tpulint TPL001: prediction.py's vmapped `_forest_leaves`
+# replaced every caller long ago, leaving it dead — and a dead eager
+# loop is one import away from dispatching op-by-op. Its KNOWN_JITTED
+# allowlist entry was stale (nothing jitted it), and its eager-scope
+# references also demoted `predict_leaf_raw`/`_traverse` out of the
+# derived jit-reachable set. `python -m lightgbm_tpu lint` guards the
+# replacement path.

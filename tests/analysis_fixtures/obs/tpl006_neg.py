@@ -1,0 +1,25 @@
+# tpulint fixture: TPL006 negative — dispatch outside the lock.
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_lock = threading.Lock()
+_state = {"total": 0.0}
+
+
+def record(values):
+    total = float(jnp.sum(values))    # dispatch FIRST, lock-free
+    with _lock:
+        _state["total"] += total      # pure python under the lock
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.snapshots = []
+
+    def observe(self, x):
+        y = jax.device_put(x)         # dispatch outside
+        with self._lock:
+            self.snapshots.append(y)  # bookkeeping inside

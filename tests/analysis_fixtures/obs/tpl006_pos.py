@@ -1,0 +1,28 @@
+# tpulint fixture: TPL006 positive — lock held across jax dispatch.
+# Lives under obs/ because the rule is scoped to the telemetry layer.
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_lock = threading.Lock()
+_state = {"total": 0.0}
+
+
+def record(values):
+    with _lock:
+        # EXPECT: TPL006
+        total = jnp.sum(values)        # dispatch while holding _lock
+        _state["total"] += float(total)
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.acc = None
+
+    def observe(self, x):
+        with self._lock:
+            # EXPECT: TPL006
+            y = jax.device_put(x)
+            self.acc = y

@@ -1,0 +1,36 @@
+# tpulint fixture: TPL001 negative — every lax loop is jit-reachable.
+# No EXPECT lines: the engine must report nothing here.
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def decorated(xs):
+    def body(i, acc):
+        return acc + xs[i]
+    return lax.fori_loop(0, xs.shape[0], body, jnp.float32(0.0))
+
+
+def _impl(xs):
+    """Only entered through the module-level jit wrapper below and the
+    decorated function above -> derived jit-reachable."""
+    def body(carry, x):
+        return carry + x, None
+    total, _ = lax.scan(body, jnp.float32(0.0), xs)
+    return decorated(xs) + total
+
+
+wrapped = jax.jit(_impl)
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def partial_decorated(xs, n):
+    def helper(ys):
+        def body(i, acc):
+            return acc + ys[i]
+        return lax.fori_loop(0, n, body, jnp.float32(0.0))
+    # helper is referenced only from this traced body
+    return helper(xs)

@@ -1,0 +1,39 @@
+# tpulint fixture: TPL001 positive — eager lax loops with no jit entry.
+# An `# EXPECT: <RULE>` comment pins a finding (by rule id + line
+# number) on the line that FOLLOWS it; tests/test_static_analysis.py
+# asserts exact equality. Fixtures are never imported, only parsed.
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def eager_sum(xs):
+    def body(i, acc):
+        return acc + xs[i]
+    # EXPECT: TPL001
+    return lax.fori_loop(0, xs.shape[0], body, jnp.float32(0.0))
+
+
+def eager_scan(xs):
+    def body(carry, x):
+        return carry + x, None
+    # EXPECT: TPL001
+    total, _ = jax.lax.scan(body, jnp.float32(0.0), xs)
+    return total
+
+
+def mixed_entry(xs):
+    """Jitted by the wrapper below, but ALSO called eagerly from
+    driver() — a mixed-entry function is not jit-only, so its loop can
+    still dispatch eagerly."""
+    def body(i, acc):
+        return acc + xs[i]
+    # EXPECT: TPL001
+    return lax.fori_loop(0, xs.shape[0], body, jnp.float32(0.0))
+
+
+mixed_jit = jax.jit(mixed_entry)
+
+
+def driver(xs):
+    return mixed_entry(xs)

@@ -1,0 +1,33 @@
+# tpulint fixture: TPL002 negative — no findings expected.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_TABLE = np.asarray([1.0, 2.0, 4.0])  # module level: host code, fine
+
+
+@jax.jit
+def traced_const(x):
+    # np on values NOT derived from parameters = trace-time constant
+    # folding (building a static table), not a runtime sync
+    table = np.asarray([0.0, 1.0])
+    return x + jnp.asarray(table) + jnp.asarray(_TABLE[0])
+
+
+# tpulint: hot
+def hot_but_async(vec):
+    # the async-copy API is the FIX for TPL002, never a finding
+    vec.copy_to_host_async()
+    return vec
+
+
+def cold_host_path(x):
+    # not traced, not hot: host materialization is this layer's job
+    arr = np.asarray(x)
+    return float(arr[0])
+
+
+# tpulint: hot
+def hot_with_justified_sync(flags):
+    # tpulint: disable=TPL002 flags were copy_to_host_async'd an iteration ago
+    return np.asarray(flags)

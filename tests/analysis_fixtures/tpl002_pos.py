@@ -1,0 +1,24 @@
+# tpulint fixture: TPL002 positive — host syncs in traced / hot code.
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def traced_sync(x):
+    # EXPECT: TPL002
+    host = np.asarray(x)          # concretizes a tracer
+    # EXPECT: TPL002
+    s = float(x[0])               # float() on a tracer
+    return jnp.sum(jnp.asarray(host)) + s
+
+
+# tpulint: hot
+def per_iteration_driver(score, tree):
+    # EXPECT: TPL002
+    fetched = jax.device_get(score)
+    # EXPECT: TPL002
+    n = tree.num_leaves.item()
+    # EXPECT: TPL002
+    score.block_until_ready()
+    return fetched, n

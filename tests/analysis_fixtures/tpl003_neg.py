@@ -1,0 +1,26 @@
+# tpulint fixture: TPL003 negative — stable trace signatures.
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _impl(x, n):
+    return x * n
+
+
+stepper = jax.jit(_impl, static_argnums=(1,))
+
+# module level, outside any loop: compiled once
+hoisted = jax.jit(lambda v: v * 2)
+
+
+def ok(xs, cfg):
+    out = []
+    for _ in range(3):
+        # statics derived from shapes/config are stable per dataset
+        out.append(stepper(xs, xs.shape[0]))
+        out.append(stepper(xs, cfg.num_leaves))
+        out.append(hoisted(xs))
+    # literal statics never retrace
+    return out + [stepper(xs, 4)]

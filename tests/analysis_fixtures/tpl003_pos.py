@@ -1,0 +1,30 @@
+# tpulint fixture: TPL003 positive — recompile hazards.
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _impl(x, n):
+    return x * n
+
+
+stepper = jax.jit(_impl, static_argnums=(1,))
+named = jax.jit(_impl, static_argnames=("n",))
+
+
+def storm(xs, counts):
+    out = []
+    for c in counts:
+        # EXPECT: TPL003
+        f = jax.jit(lambda v: v * 2)   # fresh wrapper per iteration
+        # EXPECT: TPL003
+        out.append(stepper(xs, int(c)))          # data -> static pos
+        # EXPECT: TPL003
+        out.append(named(xs, n=float(c.max())))  # data -> static name
+    return out
+
+
+def storm_partial(xs, c):
+    # EXPECT: TPL003
+    return stepper(xs, c.item())      # .item() into a static position

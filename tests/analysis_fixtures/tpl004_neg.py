@@ -1,0 +1,23 @@
+# tpulint fixture: TPL004 negative — donation used correctly.
+import jax
+import jax.numpy as jnp
+
+
+def _step(score, grad):
+    return score + grad
+
+
+fused = jax.jit(_step, donate_argnums=(0,))
+
+
+def train(score, grad):
+    before = jnp.sum(score)       # read BEFORE donation: fine
+    score = fused(score, grad)    # rebound to the result immediately
+    after = jnp.sum(score)        # reads the NEW buffer
+    return before, after
+
+
+def train_loop(score, grads):
+    for g in grads:
+        score = fused(score, g)   # rebound each iteration
+    return score

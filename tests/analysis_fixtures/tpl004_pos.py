@@ -1,0 +1,17 @@
+# tpulint fixture: TPL004 positive — use after donation.
+import jax
+import jax.numpy as jnp
+
+
+def _step(score, grad):
+    return score + grad
+
+
+fused = jax.jit(_step, donate_argnums=(0,))
+
+
+def train(score, grad):
+    new_score = fused(score, grad)
+    # EXPECT: TPL004
+    drift = jnp.sum(score)       # `score` was donated above: dead
+    return new_score, drift

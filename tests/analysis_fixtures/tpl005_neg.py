@@ -1,0 +1,32 @@
+# tpulint fixture: TPL005 negative — deterministic iteration only.
+import jax
+import jax.numpy as jnp
+
+
+def reduce_shards(shards):
+    total = jnp.float32(0.0)
+    names = {s.name for s in shards}
+    for name in sorted(names):        # sorted(): total deterministic order
+        total = total + jax.lax.psum(shards[name], "x")
+    return total
+
+
+def list_order(parts, keys):
+    ordered = [k for k in keys]       # list in, list out
+    return jnp.stack([parts[k] for k in ordered])
+
+
+def membership_only(callbacks):
+    before = {c for c in callbacks if c.enabled}
+    # set MEMBERSHIP is order-free — only iteration is hazardous
+    rest = [c for c in callbacks if c not in before]
+    for c in rest:
+        c(jnp.zeros(()))
+    return rest
+
+
+def host_only_set(tags):
+    # set iteration with no jax dispatch anywhere near it: out of
+    # TPL005's blast radius (pure host bookkeeping)
+    seen = set(tags)
+    return {t: len(t) for t in sorted(seen)}

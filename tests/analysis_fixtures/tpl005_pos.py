@@ -1,0 +1,29 @@
+# tpulint fixture: TPL005 positive — hash-ordered iteration feeding
+# device work.
+import jax
+import jax.numpy as jnp
+
+
+def reduce_shards(shards):
+    total = jnp.float32(0.0)
+    names = {s.name for s in shards}           # a set
+    # EXPECT: TPL005
+    for name in names:                         # hash order -> psum order
+        total = total + jax.lax.psum(shards[name], "x")
+    return total
+
+
+def trace_order(parts):
+    keys = set(parts)
+    # EXPECT: TPL005
+    stacked = jnp.stack([parts[k] for k in keys])   # comprehension
+    return stacked
+
+
+def tied_sort(callbacks):
+    cbs = {c for c in callbacks if c.enabled}
+    # EXPECT: TPL005
+    ordered = sorted(cbs, key=lambda c: c.order)    # ties keep set order
+    for c in ordered:
+        c(jnp.zeros(()))
+    return ordered

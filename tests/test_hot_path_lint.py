@@ -1,176 +1,30 @@
-"""Static guard against the eager-loop regression class.
+"""Thin compatibility wrapper — the hot-path lint grew into tpulint.
 
-PROFILE.md (round 5) records a 530 ms/iter regression whose root cause
-was a ``lax`` loop dispatching eagerly — op-by-op through the device
-tunnel — instead of inside one jitted program. Op-level timing looks
-fine in microbenchmarks, so nothing catches it at runtime; this lint
-catches it at review time instead: every ``lax.fori_loop`` /
-``lax.scan`` / ``lax.while_loop`` call in the boosting path
-(``models/gbdt.py`` + ``ops/``) must live inside a function on the
-KNOWN_JITTED allowlist — functions whose only entry is through a
-``jax.jit`` wrapper (``grow_tree``, the fused-iteration program, the
-prediction jits).
+The ad-hoc AST guard that lived here (an eager-``lax``-loop check over
+``models/gbdt.py`` + ``ops/`` gated by a hand-maintained
+``KNOWN_JITTED`` allowlist) became a real analyzer:
+``lightgbm_tpu/analysis/`` — a cross-module call graph that DERIVES
+the jit-reachable set, plus the TPL001-TPL006 hazard catalog
+(docs/STATIC_ANALYSIS.md), run via ``python -m lightgbm_tpu lint``.
 
-Adding a new device loop? Put it behind a jitted entry point, register
-that entry point with ``obs.register_jit`` (so recompiles are counted),
-and add the enclosing function here.
+This file stays so history/docs links keep working; the tests live in
+``tests/test_static_analysis.py``. ``KNOWN_JITTED`` is now an
+ASSERTION over the derived set (catching both stale and missing
+entries), not an input to the lint. Migration notes:
+
+- the old allowlist's ``predict_forest_raw`` entry was STALE: nothing
+  ever jitted that function (dead since prediction.py's vmapped
+  ``_forest_leaves``), and its eager-scope references silently demoted
+  ``predict_leaf_raw``/``_traverse`` too. tpulint TPL001 caught it;
+  the dead function was removed.
+- the ``_train_one_iter_fused`` host-fetch guard is now rule TPL002
+  driven by the ``# tpulint: hot`` marker on the function.
 """
 
-import ast
-import os
-
-import pytest
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "lightgbm_tpu")
-
-LOOP_NAMES = {"fori_loop", "scan", "while_loop"}
-
-# root-level functions whose bodies are only ever traced (verified:
-# every call path enters through a jax.jit wrapper)
-KNOWN_JITTED = {
-    ("ops/gather.py", "_gather_small"),      # gather_small jit
-    ("ops/grow.py", "_grow_masked_impl"),    # grow_tree jit
-    ("ops/grow.py", "_grow_compact_impl"),   # grow_tree jit
-    ("ops/histogram.py", "_hist_from_rows_impl"),
-    ("ops/histogram.py", "_hist_scatter"),
-    ("ops/predict.py", "_traverse"),         # predict jits
-    ("ops/predict.py", "predict_forest_raw"),
-}
-
-
-def _hot_path_files():
-    out = [os.path.join(PKG, "models", "gbdt.py")]
-    ops = os.path.join(PKG, "ops")
-    out.extend(os.path.join(ops, f) for f in sorted(os.listdir(ops))
-               if f.endswith(".py"))
-    return out
-
-
-def _loop_sites(path):
-    """(lineno, loop_name, root_function) of every lax loop call."""
-    with open(path, encoding="utf-8") as fh:
-        tree = ast.parse(fh.read(), filename=path)
-    sites = []
-
-    def visit(node, stack):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            stack = stack + [node.name]
-        if isinstance(node, ast.Call):
-            fn = node.func
-            if isinstance(fn, ast.Attribute) and fn.attr in LOOP_NAMES:
-                root = stack[0] if stack else "<module>"
-                sites.append((node.lineno, fn.attr, root))
-        for child in ast.iter_child_nodes(node):
-            visit(child, stack)
-
-    visit(tree, [])
-    return sites
-
-
-def test_no_eager_lax_loops_in_boosting_path():
-    offenders = []
-    for path in _hot_path_files():
-        rel = os.path.relpath(path, PKG).replace(os.sep, "/")
-        for lineno, loop, root in _loop_sites(path):
-            if (rel, root) not in KNOWN_JITTED:
-                offenders.append(f"{rel}:{lineno}: lax.{loop} in "
-                                 f"{root}() is not on the KNOWN_JITTED "
-                                 "allowlist")
-    assert not offenders, (
-        "eager-dispatch risk (PROFILE.md 530 ms/iter class):\n  "
-        + "\n  ".join(offenders))
-
-
-def _function_node(tree, qualpath):
-    """Find a (possibly nested) FunctionDef by ['outer', 'inner'] path."""
-    nodes = [tree]
-    for name in qualpath:
-        found = None
-        for node in nodes:
-            for child in ast.walk(node):
-                if isinstance(child, (ast.FunctionDef,
-                                      ast.AsyncFunctionDef)) \
-                        and child.name == name:
-                    found = child
-                    break
-            if found is not None:
-                break
-        assert found is not None, f"function {'.'.join(qualpath)} not found"
-        nodes = [found]
-    return nodes[0]
-
-
-def test_nonfinite_guard_stays_inside_jitted_step():
-    """The resilience guard contract (docs/RESILIENCE.md): the
-    non-finite check on gradients/hessians/leaf values must live INSIDE
-    the fused jitted step (one fused reduction), and the fused
-    iteration wrapper must not grow an eager per-iteration host fetch
-    (np.asarray / device_get / block_until_ready) — that would
-    serialize the device pipeline, the exact regression class the lint
-    above guards against."""
-    path = os.path.join(PKG, "models", "gbdt.py")
-    with open(path, encoding="utf-8") as fh:
-        tree = ast.parse(fh.read(), filename=path)
-
-    # (1) guard fused into the traced program: `step` (the body jitted
-    # by _get_fused_fn) must trace the guard — either inline isfinite
-    # reductions or calls into the shared pure-jnp guard helpers
-    # (_gh_flag_clamp / _leaf_guard), which themselves must reduce via
-    # isfinite
-    guard_helpers = {"_gh_flag_clamp", "_leaf_guard"}
-
-    def _calls(fn_node):
-        names = set()
-        for n in ast.walk(fn_node):
-            if isinstance(n, ast.Call):
-                if isinstance(n.func, ast.Attribute):
-                    names.add(n.func.attr)
-                elif isinstance(n.func, ast.Name):
-                    names.add(n.func.id)
-        return names
-
-    step = _function_node(tree, ["_get_fused_fn", "step"])
-    step_calls = _calls(step)
-    assert "isfinite" in step_calls or (step_calls & guard_helpers), (
-        "the non-finite guard left the fused jitted step: "
-        "_get_fused_fn.step must trace jnp.isfinite (directly or via "
-        "_gh_flag_clamp/_leaf_guard), not check eagerly")
-    for helper in guard_helpers & step_calls:
-        node = _function_node(tree, [helper])
-        assert "isfinite" in _calls(node), (
-            f"{helper} no longer reduces via jnp.isfinite — the fused "
-            "guard is gone")
-
-    # (2) no host materialization in the fused iteration driver: the
-    # guard flag must travel through the async one-iteration-late queue
-    fused = _function_node(tree, ["_train_one_iter_fused"])
-    offenders = []
-    for n in ast.walk(fused):
-        if not (isinstance(n, ast.Call)
-                and isinstance(n.func, ast.Attribute)):
-            continue
-        attr = n.func.attr
-        base = n.func.value
-        if attr == "block_until_ready":
-            offenders.append(f"line {n.lineno}: .block_until_ready()")
-        elif isinstance(base, ast.Name) and (base.id, attr) in (
-                ("np", "asarray"), ("jax", "device_get"),
-                ("np", "array")):
-            offenders.append(f"line {n.lineno}: {base.id}.{attr}()")
-    assert not offenders, (
-        "eager host fetch in _train_one_iter_fused (guard/fault flags "
-        "must use the async _push_guard_flags queue):\n  "
-        + "\n  ".join(offenders))
-
-
-def test_allowlist_entries_still_exist():
-    """A renamed/deleted function must be pruned from the allowlist —
-    stale entries would silently stop guarding anything."""
-    live = set()
-    for path in _hot_path_files():
-        rel = os.path.relpath(path, PKG).replace(os.sep, "/")
-        for _, _, root in _loop_sites(path):
-            live.add((rel, root))
-    stale = KNOWN_JITTED - live
-    assert not stale, f"prune stale allowlist entries: {sorted(stale)}"
+from test_static_analysis import (  # noqa: F401
+    KNOWN_JITTED,
+    test_every_hot_path_lax_loop_is_jit_reachable,
+    test_known_jitted_covered_by_derived_set,
+    test_known_jitted_entries_exist,
+    test_nonfinite_guard_stays_inside_jitted_step,
+)

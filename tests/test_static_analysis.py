@@ -1,0 +1,337 @@
+"""tpulint (lightgbm_tpu/analysis/) — the tier-1 static-analysis gate.
+
+Four layers, all jax-free and fast (<10 s over the whole package):
+
+1. The package itself must lint clean against the checked-in baseline
+   (tools/tpulint_baseline.txt), every baseline entry must carry a
+   justification, and no entry may be stale.
+2. The derived jit-reachable set must cover the entry points the old
+   hand-maintained ``KNOWN_JITTED`` allowlist tracked — renaming
+   ``_grow_masked_impl`` (or breaking its jit wrapping) fails here, so
+   the allowlist is now computed, not maintained.
+3. Per-rule fixtures (tests/analysis_fixtures/): one positive and one
+   negative file per rule, asserted by finding id and line number via
+   ``# EXPECT: TPLNNN`` markers (the marker pins the line after it).
+4. CLI contract: ``python -m lightgbm_tpu lint`` runs WITHOUT importing
+   jax, honors --rule/--format/--baseline, and exits 0/1 as documented.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "lightgbm_tpu")
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "analysis_fixtures")
+BASELINE = os.path.join(REPO, "tools", "tpulint_baseline.txt")
+
+sys.path.insert(0, REPO)
+
+from lightgbm_tpu.analysis import build_callgraph, run_lint  # noqa: E402
+from lightgbm_tpu.analysis.baseline import load_baseline  # noqa: E402
+
+import functools  # noqa: E402
+
+
+# tests/test_hot_path_lint.py re-exports several of these tests (thin
+# compat wrapper), so pytest runs them twice per tier-1 pass; cache the
+# package-wide analyses so the duplicates cost ~0 instead of ~2 s each
+@functools.lru_cache(maxsize=None)
+def _cached_graph():
+    return build_callgraph(PKG)
+
+
+@functools.lru_cache(maxsize=None)
+def _cached_lint(rules=None):
+    return run_lint(root=PKG, rules=list(rules) if rules else None,
+                    baseline_path=BASELINE)
+
+
+# ---------------------------------------------------------------------
+# 1. the shipped tree is clean
+# ---------------------------------------------------------------------
+
+def test_package_lints_clean_against_baseline():
+    res = _cached_lint()
+    assert not res.findings, (
+        "new tpulint findings (fix them, or baseline WITH a "
+        "justification — see docs/STATIC_ANALYSIS.md):\n  "
+        + "\n  ".join(f"{f.fid} @ {f.relpath}:{f.lineno}"
+                      for f in res.findings))
+    assert not res.stale_baseline, (
+        "stale baseline entries (the finding no longer occurs — "
+        "delete them from tools/tpulint_baseline.txt):\n  "
+        + "\n  ".join(e.fid for e in res.stale_baseline))
+    assert res.elapsed < 10.0, (
+        f"analyzer took {res.elapsed:.1f}s over the package; the "
+        "review-time budget is 10s")
+
+
+def test_baseline_entries_all_justified():
+    entries = load_baseline(BASELINE)
+    assert entries, "baseline file missing or empty (expected at "\
+        f"{BASELINE})"
+    unjustified = [e.fid for e in entries if not e.justification]
+    assert not unjustified, (
+        "baseline entries without an inline justification comment: "
+        + ", ".join(unjustified))
+
+
+# ---------------------------------------------------------------------
+# 2. KNOWN_JITTED, migrated: the allowlist is now DERIVED
+# ---------------------------------------------------------------------
+
+# The old tests/test_hot_path_lint.py allowlist (minus the stale
+# `predict_forest_raw` entry, which tpulint exposed as a dead eager
+# loop nothing ever jitted — removed in the same change), plus the
+# wider lax-loop-bearing entry points the call graph proves. If any of
+# these leaves the derived set (renamed, de-jitted, newly referenced
+# from eager code), this fails and names it.
+KNOWN_JITTED = {
+    ("ops/gather.py", "_gather_small"),
+    ("ops/grow.py", "_grow_masked_impl"),
+    ("ops/grow.py", "_grow_compact_impl"),
+    ("ops/grow.py", "grow_tree_impl"),
+    ("ops/histogram.py", "_hist_from_rows_impl"),
+    ("ops/histogram.py", "_hist_scatter"),
+    ("ops/histogram.py", "build_histogram"),
+    ("ops/predict.py", "_traverse"),
+    ("ops/predict.py", "predict_leaf_binned"),
+    ("ops/predict.py", "predict_leaf_raw"),
+    ("ranking.py", "_lambdarank_grads"),
+    ("models/gbdt.py", "GBDTBooster._get_fused_fn.step"),
+}
+
+
+def test_known_jitted_covered_by_derived_set():
+    graph = _cached_graph()
+    missing = KNOWN_JITTED - graph.jit_reachable
+    assert not missing, (
+        "functions expected to be jit-only left the DERIVED "
+        "jit-reachable set (renamed? de-jitted? now referenced from "
+        f"eager code?): {sorted(missing)}")
+
+
+def test_known_jitted_entries_exist():
+    """A renamed/deleted function must be pruned here — stale entries
+    would silently stop guarding anything (the failure mode that let
+    the old allowlist carry `predict_forest_raw` for a dead
+    function)."""
+    graph = _cached_graph()
+    live = {(p, q) for (p, q) in graph.funcs}
+    stale = KNOWN_JITTED - live
+    assert not stale, f"prune stale KNOWN_JITTED entries: {sorted(stale)}"
+
+
+def test_every_hot_path_lax_loop_is_jit_reachable():
+    """The old test's core property, generalized from models/gbdt.py +
+    ops/ to the full rule scope: zero non-baselined TPL001s."""
+    res = _cached_lint(("TPL001",))
+    assert not res.findings, (
+        "eager-dispatch risk (PROFILE.md 530 ms/iter class):\n  "
+        + "\n  ".join(f"{f.relpath}:{f.lineno}: {f.fid}"
+                      for f in res.findings))
+
+
+# ---------------------------------------------------------------------
+# 3. per-rule fixtures, asserted by id + line
+# ---------------------------------------------------------------------
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*(TPL\d{3})\s*$")
+
+
+def _expected_findings(path: str):
+    """(rule, lineno) pairs pinned by `# EXPECT: TPLNNN` markers — the
+    marker names the line that FOLLOWS it."""
+    out = []
+    with open(path, encoding="utf-8") as fh:
+        for i, line in enumerate(fh, start=1):
+            m = _EXPECT_RE.search(line)
+            if m:
+                out.append((m.group(1), i + 1))
+    return sorted(out)
+
+
+_FIXTURES = [
+    "tpl001_pos.py", "tpl001_neg.py",
+    "tpl002_pos.py", "tpl002_neg.py",
+    "tpl003_pos.py", "tpl003_neg.py",
+    "tpl004_pos.py", "tpl004_neg.py",
+    "tpl005_pos.py", "tpl005_neg.py",
+    "obs/tpl006_pos.py", "obs/tpl006_neg.py",
+]
+
+
+@pytest.mark.parametrize("relpath", _FIXTURES)
+def test_rule_fixture(relpath):
+    res = run_lint(root=FIXTURES, package="tpulint_fixtures",
+                   files=[relpath], baseline_path="")
+    got = sorted((f.rule, f.lineno) for f in res.findings)
+    expected = _expected_findings(os.path.join(FIXTURES, relpath))
+    assert got == expected, (
+        f"{relpath}: findings diverge from # EXPECT markers\n"
+        f"  expected: {expected}\n  got:      {got}\n  "
+        + "\n  ".join(f"{f.fid} @ {f.lineno}: {f.message[:100]}"
+                      for f in res.findings))
+
+
+def test_fixture_positive_files_have_expectations():
+    for rel in _FIXTURES:
+        expected = _expected_findings(os.path.join(FIXTURES, rel))
+        if "_pos" in rel:
+            assert expected, f"{rel} has no # EXPECT markers"
+        else:
+            assert not expected, f"{rel} is a negative fixture but " \
+                                 "carries # EXPECT markers"
+
+
+def test_every_rule_has_fixture_coverage():
+    from lightgbm_tpu.analysis import ALL_RULES
+    covered = set()
+    for rel in _FIXTURES:
+        for rule, _ in _expected_findings(os.path.join(FIXTURES, rel)):
+            covered.add(rule)
+    missing = {r.id for r in ALL_RULES} - covered
+    assert not missing, f"rules without a positive fixture: {missing}"
+
+
+# ---------------------------------------------------------------------
+# 4. CLI contract (and the no-jax guarantee)
+# ---------------------------------------------------------------------
+
+def test_cli_lint_runs_without_jax():
+    """`python -m lightgbm_tpu lint` must complete without importing
+    jax anywhere on its path (review-time tooling runs where no
+    backend can initialize). Proved in a subprocess: after a full lint
+    run, 'jax' must be absent from sys.modules."""
+    code = (
+        "import sys\n"
+        "from lightgbm_tpu.analysis.cli import main\n"
+        "rc = main(['--format', 'json'])\n"
+        "assert 'jax' not in sys.modules, 'lint imported jax!'\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\nstdout: {proc.stdout[-2000:]}\n"
+        f"stderr: {proc.stderr[-2000:]}")
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert payload["jit_reachable"], "empty derived jit-reachable set"
+
+
+def test_cli_rule_filter_and_exit_code():
+    # a fresh finding (no baseline) must exit 1 and honor --rule
+    res = run_lint(root=FIXTURES, package="tpulint_fixtures",
+                   files=["tpl001_pos.py"], rules=["TPL001"],
+                   baseline_path="")
+    assert res.findings and all(f.rule == "TPL001" for f in res.findings)
+    res2 = run_lint(root=FIXTURES, package="tpulint_fixtures",
+                    files=["tpl001_pos.py"], rules=["TPL004"],
+                    baseline_path="")
+    assert not res2.findings  # rule filter excludes the TPL001 hits
+    with pytest.raises(ValueError):
+        run_lint(root=FIXTURES, package="tpulint_fixtures",
+                 files=["tpl001_pos.py"], rules=["TPL999"])
+
+
+def test_cli_help_mentions_exit_codes():
+    from lightgbm_tpu.analysis.cli import EXIT_CODES, build_parser
+    text = build_parser().format_help()
+    assert "exit codes:" in text
+    assert "--rule" in text and "--baseline" in text
+    assert EXIT_CODES.strip().splitlines()[1].strip().startswith("0")
+
+
+def test_finding_ids_are_line_number_free():
+    res = run_lint(root=FIXTURES, package="tpulint_fixtures",
+                   files=["tpl001_pos.py"], baseline_path="")
+    for f in res.findings:
+        assert f.fid == f"{f.rule}:{f.relpath}:{f.func}:{f.symbol}#" \
+            + f.fid.rsplit("#", 1)[1]
+        assert str(f.lineno) not in f.fid.rsplit("#", 1)[0].replace(
+            f.relpath, "")
+
+
+# ---------------------------------------------------------------------
+# carried over from the old test_hot_path_lint.py: the resilience-guard
+# placement contract (docs/RESILIENCE.md) — still a plain-ast check
+# ---------------------------------------------------------------------
+
+def _function_node(tree, qualpath):
+    nodes = [tree]
+    for name in qualpath:
+        found = None
+        for node in nodes:
+            for child in ast.walk(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) \
+                        and child.name == name:
+                    found = child
+                    break
+            if found is not None:
+                break
+        assert found is not None, \
+            f"function {'.'.join(qualpath)} not found"
+        nodes = [found]
+    return nodes[0]
+
+
+def test_nonfinite_guard_stays_inside_jitted_step():
+    """The resilience guard contract: the non-finite check on
+    gradients/hessians/leaf values must live INSIDE the fused jitted
+    step (one fused reduction), and the fused iteration wrapper must
+    not grow an eager per-iteration host fetch — TPL002 enforces the
+    latter through the `# tpulint: hot` marker, re-asserted here."""
+    path = os.path.join(PKG, "models", "gbdt.py")
+    with open(path, encoding="utf-8") as fh:
+        tree = ast.parse(fh.read(), filename=path)
+
+    guard_helpers = {"_gh_flag_clamp", "_leaf_guard"}
+
+    def _calls(fn_node):
+        names = set()
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Call):
+                if isinstance(n.func, ast.Attribute):
+                    names.add(n.func.attr)
+                elif isinstance(n.func, ast.Name):
+                    names.add(n.func.id)
+        return names
+
+    step = _function_node(tree, ["_get_fused_fn", "step"])
+    step_calls = _calls(step)
+    assert "isfinite" in step_calls or (step_calls & guard_helpers), (
+        "the non-finite guard left the fused jitted step: "
+        "_get_fused_fn.step must trace jnp.isfinite (directly or via "
+        "_gh_flag_clamp/_leaf_guard), not check eagerly")
+    for helper in guard_helpers & step_calls:
+        node = _function_node(tree, [helper])
+        assert "isfinite" in _calls(node), (
+            f"{helper} no longer reduces via jnp.isfinite — the fused "
+            "guard is gone")
+
+    # (2) no host materialization in the fused iteration driver —
+    # now the analyzer's job: _train_one_iter_fused is hot-marked and
+    # models/gbdt.py TPL002 findings are limited to the baseline
+    res = _cached_lint(("TPL002",))
+    fused = [f for f in res.findings
+             if f.func.endswith("_train_one_iter_fused")]
+    assert not fused, (
+        "eager host fetch in _train_one_iter_fused (guard/fault flags "
+        "must ride the async _push_guard_flags queue):\n  "
+        + "\n  ".join(f"line {f.lineno}: {f.symbol}" for f in fused))
+    scan = res.graph.scans["models/gbdt.py"]
+    hot = {q for q, i in scan.funcs.items() if i.is_hot}
+    assert "GBDTBooster._train_one_iter_fused" in hot, (
+        "_train_one_iter_fused lost its '# tpulint: hot' marker — "
+        "TPL002 no longer guards the fused driver")

@@ -1,0 +1,9 @@
+#!/usr/bin/env sh
+# One-shot tpulint runner: analyzer + baseline check. Exits non-zero on
+# any non-baselined finding AND on stale/unjustified baseline entries
+# (--strict), so CI catches both new hazards and rotted acceptances.
+# No jax import happens on this path — safe for backend-less runners.
+set -eu
+cd "$(dirname "$0")/.."
+exec python -m lightgbm_tpu lint --strict \
+    --baseline tools/tpulint_baseline.txt "$@"
