@@ -9,5 +9,13 @@ if __name__ == "__main__":
         from .analysis.cli import main as lint_main
         raise SystemExit(lint_main(sys.argv[2:]))
 
+    # `launch` is the elastic restart supervisor (resilience/elastic.py):
+    # it must not import jax either — the supervisor outlives dying
+    # worker worlds and must never pin the accelerator devices the
+    # workers need.
+    if len(sys.argv) > 1 and sys.argv[1] == "launch":
+        from .resilience.elastic import main as launch_main
+        raise SystemExit(launch_main(sys.argv[2:]))
+
     from .cli import main
     raise SystemExit(main())

@@ -473,15 +473,19 @@ class UnorderedIteration(Rule):
 # ---------------------------------------------------------------------
 class LockAcrossDispatch(Rule):
     """TPL006: a ``threading`` lock held across a jax dispatch in the
-    observability layer. Dispatch can block on the device (or on jax's
-    own internal locks); holding a telemetry lock across it turns a
-    metrics read on another thread into a pipeline stall — or a
-    deadlock if jax re-enters the instrumented path."""
+    observability or resilience layer. Dispatch can block on the device
+    (or on jax's own internal locks); holding a telemetry lock across
+    it turns a metrics read on another thread into a pipeline stall —
+    or a deadlock if jax re-enters the instrumented path. In
+    ``resilience/`` the same shape is worse: the collective watchdog's
+    bookkeeping lock held across a *collective* would hang the exact
+    abort path that exists to break hangs (watchdog.py's contract is
+    copy-under-lock, sync-outside)."""
 
     id = "TPL006"
-    title = "lock held across jax dispatch in obs/"
+    title = "lock held across jax dispatch in obs/ or resilience/"
 
-    _SCOPE_PREFIXES = ("obs/",)
+    _SCOPE_PREFIXES = ("obs/", "resilience/")
     _LOCK_CALLS = {"Lock", "RLock", "Condition", "Semaphore"}
 
     def run(self, ctx: LintContext) -> Iterator[Finding]:

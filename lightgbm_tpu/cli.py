@@ -11,6 +11,7 @@ Usage:
     python -m lightgbm_tpu stats run.jsonl     # summarize telemetry
     python -m lightgbm_tpu checkpoints <dir>   # inspect snapshots
     python -m lightgbm_tpu lint [--help]       # tpulint static analyzer
+    python -m lightgbm_tpu launch 4 -- <cmd>   # elastic restart supervisor
 
 Config-file syntax matches the reference (application.cpp:50-86 +
 config.cpp KV2Map): one ``key = value`` per line, ``#`` comments;
@@ -308,6 +309,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         # main() callers get the same surface
         from .analysis.cli import main as lint_main
         return lint_main(argv[1:])
+    if argv[0] == "launch":
+        # likewise dispatched jax-free in __main__.py; kept here for
+        # programmatic main() callers
+        from .resilience.elastic import main as launch_main
+        return launch_main(argv[1:])
     try:
         params = parse_args(argv)
         cfg = Config.from_params(params)

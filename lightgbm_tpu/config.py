@@ -487,6 +487,12 @@ class Config:
     time_out: int = 120
     machine_list_file: str = ""
     machines: str = ""
+    # deadline (seconds) for every host-level collective of a
+    # multi-process run (resilience/watchdog.py): a rank that dies or
+    # stalls mid-sync surfaces as a LightGBMError naming the stuck
+    # collective instead of an infinite hang. 0 disables; the
+    # LIGHTGBM_TPU_COLLECTIVE_TIMEOUT env var overrides
+    collective_timeout_sec: float = 300.0
 
     # ---- tpu-specific (new; no reference analog) ----
     num_devices: int = 0  # 0 = use all visible devices for data-parallel
@@ -566,6 +572,7 @@ class Config:
         "scale_pos_weight": (0.0, None, "gt"),
         "num_grad_quant_bins": (2, None),
         "num_machines": (1, None),
+        "collective_timeout_sec": (0.0, None),
         "metric_freq": (1, None),
         "multi_error_top_k": (1, None),
     }

@@ -168,8 +168,13 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
     cbs_before = sorted(cbs_before, key=lambda c: getattr(c, "order", 0))
     cbs_after = sorted(cbs_after, key=lambda c: getattr(c, "order", 0))
 
+    from .resilience import watchdog
     from .resilience.faults import FaultPlan
     fault_plan = FaultPlan.from_env()
+    # host-collective deadline for this run's sync points
+    # (telemetry/checkpoint collectives; parallel/spmd.py). Env var
+    # still overrides inside deadline_seconds().
+    watchdog.configure(cfg.collective_timeout_sec)
 
     # resume continues toward num_boost_round TOTAL iterations (train
     # 20 == train 10 then resume to 20); from-scratch runs keep the
@@ -180,6 +185,7 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
     try:
         for i in range(begin_iteration, end_iteration):
             fault_plan.maybe_kill(i)
+            fault_plan.maybe_distributed_fault(i)
             for cb in cbs_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,

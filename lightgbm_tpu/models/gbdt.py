@@ -441,26 +441,14 @@ class GBDTBooster:
     # ------------------------------------------------------------------
     def _record_fault(self, kind: str, iteration: int, action: str,
                       detail: str) -> None:
-        """Append one fault event (drained into the telemetry JSONL
-        stream by obs/recorder.py) and count it in the global metrics
-        registry. The log is capped: without a telemetry recorder
-        attached nothing drains it, and a clamp/skip_tree run on
-        persistently bad data would otherwise grow it one dict per
-        iteration forever (the registry counter still counts all)."""
-        import time as _time
-        if len(self.fault_log) >= 512:
-            del self.fault_log[0]
-        self.fault_log.append({
-            "event": "fault", "kind": kind, "iteration": int(iteration),
-            "action": action, "detail": detail, "time": _time.time()})
-        try:
-            from ..obs import registry
-            registry.counter("fault_events", kind=kind).inc()
-        except Exception:
-            pass
-        from ..utils.log import log_warning
-        log_warning(f"fault[{kind}] at iteration {iteration}: {detail} "
-                    f"-> {action}")
+        """Append one fault event to this booster's ``fault_log``
+        (drained into the telemetry JSONL stream by obs/recorder.py)
+        via the shared writer in resilience/faults.py — one schema,
+        one cap, one registry counter for both the per-engine and the
+        process-level logs."""
+        from ..resilience.faults import append_fault_event
+        append_fault_event(self.fault_log, kind, iteration, action,
+                           detail)
 
     def _gh_guard(self, it: int, grad, hess):
         """Eager-path gradient/hessian guard: fault injection, one

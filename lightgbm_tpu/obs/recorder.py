@@ -105,19 +105,29 @@ class TelemetryRecorder:
     def close(self) -> None:
         """Flush and restore the Timer to its pre-attach state. Fault
         events still queued on the engines are drained first — with
-        ``nonfinite_policy=raise`` the exception unwinds before the
-        next ``record_iteration``, and the fault line must not be
-        lost with it."""
-        self._drain_fault_events()
-        if self._file is not None:
-            self._file.close()
-            self._file = None
-        if self._prev_timer_enabled is not None:
-            from ..utils.timer import Timer
-            Timer.enable(self._prev_timer_enabled)
-            self._prev_timer_enabled = None
-        self._started = False
-        self._engines = []
+        ``nonfinite_policy=raise`` (or a watchdog abort) the exception
+        unwinds before the next ``record_iteration``, and the fault
+        line must not be lost with it. Every step runs under
+        ``finally``: a failing drain or a full disk must still close
+        the file and restore the Timer, never leave a recorder
+        half-open on the abort path."""
+        try:
+            self._drain_fault_events()
+        finally:
+            try:
+                if self._file is not None:
+                    try:
+                        self._file.close()
+                    except OSError:
+                        pass
+                    self._file = None
+            finally:
+                if self._prev_timer_enabled is not None:
+                    from ..utils.timer import Timer
+                    Timer.enable(self._prev_timer_enabled)
+                    self._prev_timer_enabled = None
+                self._started = False
+                self._engines = []
 
     # -- event assembly ------------------------------------------------
     def _phase_delta(self, keep_all: bool = False) \
@@ -186,13 +196,23 @@ class TelemetryRecorder:
 
     def _drain_fault_events(self) -> None:
         """Move fault events (non-finite guard trips, OOM downgrades;
-        models/gbdt.py ``fault_log``) into the JSONL stream. The
-        engines already counted them in the metrics registry."""
+        models/gbdt.py ``fault_log``) into the JSONL stream, plus the
+        process-level log (``resilience.faults.FAULT_EVENTS``: init
+        retries, watchdog timeouts, distributed injections). All were
+        already counted in the metrics registry at record time."""
         for eng in self._engines:
             log = getattr(eng, "fault_log", None)
             if not log:
                 continue
             events, log[:] = list(log), []
+            for ev in events:
+                self._write_line(ev)
+        try:
+            from ..resilience.faults import FAULT_EVENTS
+        except Exception:
+            return
+        if FAULT_EVENTS:
+            events, FAULT_EVENTS[:] = list(FAULT_EVENTS), []
             for ev in events:
                 self._write_line(ev)
 
@@ -259,8 +279,34 @@ class TelemetryRecorder:
 # summary side: consumed by `lightgbm_tpu stats <file.jsonl>` and bench
 # ---------------------------------------------------------------------
 
+def _stream_lines(path: str, parse):
+    """Yield ``parse(line, is_last)`` over non-empty lines with one
+    line of lookahead, skipping None results — O(1) memory."""
+    with open(path, encoding="utf-8") as fh:
+        pending: Optional[str] = None
+        for raw in fh:
+            line = raw.strip()
+            if not line:
+                continue
+            if pending is not None:
+                ev = parse(pending, False)
+                if ev is not None:
+                    yield ev
+            pending = line
+        if pending is not None:
+            ev = parse(pending, True)
+            if ev is not None:
+                yield ev
+
+
 def summarize_events(path: str) -> dict:
-    """Fold a telemetry JSONL file into one summary dict."""
+    """Fold a telemetry JSONL file into one summary dict.
+
+    A truncated FINAL line is tolerated (skipped with a warning): a
+    ``SIGKILL``/preemption can land mid-write, and the stream up to
+    that point is exactly what a post-mortem needs. Garbage anywhere
+    *before* the last line still raises — that is corruption, not a
+    crash artifact."""
     iters = 0
     phases: Dict[str, Dict[str, float]] = {}
     recompiles = 0
@@ -270,47 +316,60 @@ def summarize_events(path: str) -> dict:
     wall = 0.0
     last_eval: Dict[str, float] = {}
     faults: Dict[str, int] = {}
-    with open(path, encoding="utf-8") as fh:
-        for line in fh:
-            line = line.strip()
-            if not line:
-                continue
+
+    def _parse(line: str, is_last: bool) -> Optional[dict]:
+        try:
             ev = json.loads(line)
-            if not isinstance(ev, dict):
-                raise ValueError(
-                    f"telemetry line is not a JSON object: {line[:80]!r}")
-            if ev.get("event") == "fault":
-                kind = str(ev.get("kind", "unknown"))
-                faults[kind] = faults.get(kind, 0) + 1
-                continue
-            if ev.get("event") != "iteration":
-                continue
-            iters += 1
-            wall = max(wall, float(ev.get("wall_time", 0.0)))
-            for label, v in ev.get("phases", {}).items():
-                slot = phases.setdefault(
-                    label, {"total": 0.0, "count": 0,
-                            "max_skew": 0.0})
-                # single-process events carry total; SPMD-aggregated
-                # ones carry mean (per-process) + min/max
-                slot["total"] += float(v.get("total", v.get("mean", 0.0)))
-                slot["count"] += int(v.get("count", 0))
-                if "max" in v and "min" in v:
-                    slot["max_skew"] = max(
-                        slot["max_skew"],
-                        float(v["max"]) - float(v["min"]))
-            recompiles += int(ev.get("recompiles", {}).get("delta", 0))
-            hbm = ev.get("hbm", {})
-            for key in ("peak_bytes_in_use", "bytes_in_use"):
-                if hbm.get(key) is not None:
-                    peak_hbm = max(peak_hbm or 0, int(hbm[key]))
-                    break
-            tree = ev.get("tree", {})
-            if tree.get("leaves") is not None:
-                leaves += int(tree["leaves"])
-                gain += float(tree.get("split_gain_sum") or 0.0)
-            if ev.get("eval"):
-                last_eval = ev["eval"]
+        except ValueError:
+            if is_last:
+                from ..utils.log import log_warning
+                log_warning(
+                    f"telemetry: ignoring truncated final line in "
+                    f"{path} (the writer was killed mid-write)")
+                return None
+            raise
+        if not isinstance(ev, dict):
+            raise ValueError(
+                f"telemetry line is not a JSON object: {line[:80]!r}")
+        return ev
+
+    # streamed with one line of lookahead (telemetry files can be
+    # hundreds of MB): a line is final — and thus allowed to be a
+    # truncated crash artifact — only when nothing non-empty follows
+    events = _stream_lines(path, _parse)
+    for ev in events:
+        if ev.get("event") == "fault":
+            kind = str(ev.get("kind", "unknown"))
+            faults[kind] = faults.get(kind, 0) + 1
+            continue
+        if ev.get("event") != "iteration":
+            continue
+        iters += 1
+        wall = max(wall, float(ev.get("wall_time", 0.0)))
+        for label, v in ev.get("phases", {}).items():
+            slot = phases.setdefault(
+                label, {"total": 0.0, "count": 0,
+                        "max_skew": 0.0})
+            # single-process events carry total; SPMD-aggregated
+            # ones carry mean (per-process) + min/max
+            slot["total"] += float(v.get("total", v.get("mean", 0.0)))
+            slot["count"] += int(v.get("count", 0))
+            if "max" in v and "min" in v:
+                slot["max_skew"] = max(
+                    slot["max_skew"],
+                    float(v["max"]) - float(v["min"]))
+        recompiles += int(ev.get("recompiles", {}).get("delta", 0))
+        hbm = ev.get("hbm", {})
+        for key in ("peak_bytes_in_use", "bytes_in_use"):
+            if hbm.get(key) is not None:
+                peak_hbm = max(peak_hbm or 0, int(hbm[key]))
+                break
+        tree = ev.get("tree", {})
+        if tree.get("leaves") is not None:
+            leaves += int(tree["leaves"])
+            gain += float(tree.get("split_gain_sum") or 0.0)
+        if ev.get("eval"):
+            last_eval = ev["eval"]
     return {"iterations": iters, "wall_time": wall, "phases": phases,
             "recompiles": recompiles, "peak_hbm_bytes": peak_hbm,
             "total_leaves": leaves, "total_split_gain": gain,

@@ -13,6 +13,20 @@ processes, and ``jax.devices()`` then spans all hosts so the ordinary
 data-parallel Mesh (parallel/mesh.py) covers the pod — ICI inside a
 slice, DCN across slices — with no linker layer at all.
 
+Like the reference's socket linker (which retries its TCP handshake for
+``time_out`` minutes), initialization here survives a coordinator that
+is not up yet: connection-refused / unavailable errors are retried with
+jittered exponential backoff, and the attempt count + total backoff are
+surfaced as the ``init_retries`` / ``init_backoff_seconds`` registry
+counters (docs/OBSERVABILITY.md). Knobs:
+
+- ``LIGHTGBM_TPU_INIT_RETRIES`` — max retries after the first attempt
+  (default 10),
+- ``LIGHTGBM_TPU_INIT_BACKOFF`` — base backoff seconds (default 0.5;
+  doubled per attempt, capped at 15 s, jittered to 50-100%),
+- ``LIGHTGBM_TPU_INIT_TIMEOUT`` — per-attempt
+  ``initialization_timeout`` passed to jax (seconds).
+
 ``init_distributed`` accepts BOTH the native JAX arguments and the
 reference's machine-list vocabulary so a LightGBM-style launch config
 ports directly:
@@ -25,16 +39,35 @@ ports directly:
     # or native
     init_distributed(coordinator_address="10.0.0.1:12400",
                      num_processes=2, process_id=1)
+
+Under the launch supervisor (``python -m lightgbm_tpu launch``,
+resilience/elastic.py) the arguments can all be omitted: the supervisor
+exports ``LIGHTGBM_TPU_COORDINATOR`` / ``LIGHTGBM_TPU_NUM_PROCS`` /
+``LIGHTGBM_TPU_RANK`` and a bare ``init_distributed()`` picks them up.
 """
 
 from __future__ import annotations
 
 import os
+import random
+import re
+import time
 from typing import List, Optional, Tuple
+
+from ..utils.log import log_info, log_warning
 
 __all__ = ["init_distributed", "shutdown_distributed", "parse_machines"]
 
 _INITIALIZED = False
+
+#: backoff schedule bounds (seconds)
+_BACKOFF_CAP = 15.0
+
+#: substrings that mark an initialization error as transient — the
+#: coordinator process is not up yet or is still binding its port
+_RETRYABLE_MARKERS = ("connection refused", "unavailable",
+                     "failed to connect", "connection reset",
+                     "deadline_exceeded", "deadline exceeded")
 
 
 def parse_machines(machines: Optional[str] = None,
@@ -43,18 +76,91 @@ def parse_machines(machines: Optional[str] = None,
     """Parse the reference's machine-list formats: a comma/newline
     separated ``host:port`` string (config ``machines``) or a file with
     one ``host port`` / ``host:port`` per line (``machine_list_file``,
-    tests/distributed/_test_distributed.py:23-38)."""
+    tests/distributed/_test_distributed.py:23-38). Blank entries and
+    surrounding whitespace are ignored; a malformed entry raises
+    ``ValueError`` naming it."""
     entries: List[str] = []
     if machines:
-        entries = [m for m in machines.replace("\n", ",").split(",") if m]
+        entries = machines.replace("\n", ",").split(",")
     elif machine_list_file:
         with open(machine_list_file) as fh:
-            entries = [ln.strip() for ln in fh if ln.strip()]
+            entries = list(fh)
     out = []
-    for e in entries:
-        host, _, port = e.replace(" ", ":").partition(":")
-        out.append((host, int(port or 0)))
+    for raw in entries:
+        e = raw.strip()
+        if not e:
+            continue
+        parts = [p for p in re.split(r"[\s:]+", e) if p]
+        if len(parts) > 2:
+            raise ValueError(f"bad machine-list entry {e!r} "
+                             "(expected 'host:port' or 'host port')")
+        host = parts[0]
+        port_str = parts[1] if len(parts) == 2 else "0"
+        try:
+            port = int(port_str)
+        except ValueError:
+            raise ValueError(f"bad port {port_str!r} in machine-list "
+                             f"entry {e!r}") from None
+        out.append((host, port))
     return out
+
+
+def _is_retryable_init_error(exc: BaseException) -> bool:
+    msg = str(exc).lower()
+    return any(m in msg for m in _RETRYABLE_MARKERS)
+
+
+def _initialize_with_retry(init_kwargs: dict) -> None:
+    """``jax.distributed.initialize`` with jittered exponential backoff
+    on coordinator-not-up errors — the ``Network::Init`` retry loop
+    (linkers_socket.cpp:169) for the multi-controller runtime. Raises
+    ``LightGBMError`` with the attempt history when retries are
+    exhausted."""
+    import jax
+
+    from ..basic import LightGBMError
+    from ..obs.registry import registry
+    from ..resilience.faults import FaultPlan
+
+    plan = FaultPlan.from_env()
+    max_retries = int(os.environ.get("LIGHTGBM_TPU_INIT_RETRIES", "10"))
+    base = float(os.environ.get("LIGHTGBM_TPU_INIT_BACKOFF", "0.5"))
+    timeout = os.environ.get("LIGHTGBM_TPU_INIT_TIMEOUT")
+    if timeout:
+        init_kwargs = dict(init_kwargs,
+                           initialization_timeout=int(float(timeout)))
+    total_wait = 0.0
+    for attempt in range(max_retries + 1):
+        try:
+            plan.maybe_refuse_init()
+            jax.distributed.initialize(**init_kwargs)
+            if attempt:
+                log_info(f"init_distributed: connected after {attempt} "
+                         f"retried attempt(s), {total_wait:.2f}s of "
+                         "backoff")
+            return
+        except Exception as e:
+            if not _is_retryable_init_error(e):
+                raise
+            if attempt >= max_retries:
+                raise LightGBMError(
+                    "init_distributed: coordinator "
+                    f"{init_kwargs.get('coordinator_address') or '(auto)'} "
+                    f"still unreachable after {attempt + 1} attempts "
+                    f"({total_wait:.2f}s of backoff): {e}. Is the "
+                    "coordinator process up? Raise "
+                    "LIGHTGBM_TPU_INIT_RETRIES / "
+                    "LIGHTGBM_TPU_INIT_BACKOFF for slower bring-up."
+                ) from e
+            delay = min(_BACKOFF_CAP, base * (2.0 ** attempt))
+            delay *= 0.5 + 0.5 * random.random()   # jitter: 50-100%
+            registry.counter("init_retries").inc()
+            registry.counter("init_backoff_seconds").inc(delay)
+            log_warning(
+                f"init_distributed: attempt {attempt + 1} failed "
+                f"({e}); retrying in {delay:.2f}s")
+            total_wait += delay
+            time.sleep(delay)
 
 
 def init_distributed(coordinator_address: Optional[str] = None,
@@ -64,19 +170,22 @@ def init_distributed(coordinator_address: Optional[str] = None,
                      machine_list_file: Optional[str] = None,
                      local_rank: Optional[int] = None) -> None:
     """Wire this process into a multi-host JAX runtime (the
-    ``LGBM_NetworkInit`` / ``Network::Init`` analog).
+    ``LGBM_NetworkInit`` / ``Network::Init`` analog), retrying with
+    backoff while the coordinator comes up.
 
     With reference-style arguments, the first machine in the list is
     the coordinator and ``local_rank`` (or env ``LIGHTGBM_TPU_RANK``)
     selects this process's slot. A single-entry machine list is a
-    no-op, matching ``num_machines=1``. Under standard TPU pod
-    launchers (GKE/queued resources) the arguments can all be omitted —
-    ``jax.distributed.initialize()`` discovers the topology itself.
+    no-op, matching ``num_machines=1``. With no arguments at all, the
+    launch supervisor's ``LIGHTGBM_TPU_COORDINATOR`` /
+    ``LIGHTGBM_TPU_NUM_PROCS`` / ``LIGHTGBM_TPU_RANK`` environment is
+    honored; absent that too, ``jax.distributed.initialize()``
+    discovers the topology itself (standard TPU pod launchers —
+    GKE/queued resources).
     """
     global _INITIALIZED
     if _INITIALIZED:
         return
-    import jax
 
     if coordinator_address is None and (machines or machine_list_file):
         mlist = parse_machines(machines, machine_list_file)
@@ -95,12 +204,29 @@ def init_distributed(coordinator_address: Optional[str] = None,
             process_id = rank
 
     if coordinator_address is None and num_processes is None:
-        jax.distributed.initialize()
+        # launch-supervisor environment (resilience/elastic.py)
+        env_coord = os.environ.get("LIGHTGBM_TPU_COORDINATOR")
+        if env_coord:
+            nproc_env = os.environ.get("LIGHTGBM_TPU_NUM_PROCS")
+            rank_env = os.environ.get("LIGHTGBM_TPU_RANK")
+            if nproc_env is None or rank_env is None:
+                raise ValueError(
+                    "LIGHTGBM_TPU_COORDINATOR is set but "
+                    "LIGHTGBM_TPU_NUM_PROCS / LIGHTGBM_TPU_RANK are "
+                    "not — all three are required (the launch "
+                    "supervisor exports them together; see "
+                    "docs/RESILIENCE.md)")
+            coordinator_address = env_coord
+            num_processes = int(nproc_env)
+            process_id = int(rank_env)
+
+    if coordinator_address is None and num_processes is None:
+        _initialize_with_retry({})
     else:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id)
+        _initialize_with_retry({
+            "coordinator_address": coordinator_address,
+            "num_processes": num_processes,
+            "process_id": process_id})
     _INITIALIZED = True
 
 

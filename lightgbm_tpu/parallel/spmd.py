@@ -43,15 +43,20 @@ def verify_step_consistency(iteration: int, num_trees: int) -> None:
     collective deadlock (ranks waiting in different allgathers) or as
     quietly different models per rank. One tiny [2]-int64 allgather per
     sync turns that into an immediate, attributable ``LightGBMError``.
-    Single-process: free no-op."""
+    The allgather runs under the collective watchdog
+    (resilience/watchdog.py), so a rank that died or stalled before
+    this sync point surfaces as a deadline error naming this
+    collective instead of an infinite hang. Single-process: free
+    no-op."""
     import jax
 
     if jax.process_count() <= 1:
         return
-    from jax.experimental import multihost_utils
+    from .hostsync import host_allgather
 
     local = np.asarray([int(iteration), int(num_trees)], np.int64)
-    g = np.asarray(multihost_utils.process_allgather(local))  # [P, 2]
+    g = host_allgather(local, "spmd/verify_step",
+                       iteration=int(iteration))  # [P, 2]
     if not (g == g[0]).all():
         from ..basic import LightGBMError
         detail = "; ".join(
@@ -74,11 +79,12 @@ def aggregate_phase_snapshot(snap: dict) -> dict:
     the identical label set; callers must pass the UNFILTERED label set
     (the recorder does) so every rank joins the allgather with an
     identical vector shape. The totals are stacked into one vector and
-    allgathered via the existing collective helpers (one small host
-    collective per event, same transport as ``sync_bin_mappers``). A
-    collective failure propagates — failing fast beats the rank-
-    divergent deadlock a per-rank fallback would cause, with some ranks
-    inside the collective and others already past it.
+    allgathered via the watchdog-guarded host transport (one small
+    host collective per event, same transport as
+    ``sync_bin_mappers``). A collective failure propagates — failing
+    fast beats the rank-divergent deadlock a per-rank fallback would
+    cause, with some ranks inside the collective and others already
+    past it.
 
     Single-process: min == max == mean == the local total, so the JSONL
     schema is invariant to the topology.
@@ -88,9 +94,8 @@ def aggregate_phase_snapshot(snap: dict) -> dict:
     labels = sorted(snap)
     totals = np.asarray([snap[lb]["total"] for lb in labels], np.float64)
     if jax.process_count() > 1 and labels:
-        from jax.experimental import multihost_utils
-        g = np.asarray(
-            multihost_utils.process_allgather(totals))  # [P, L]
+        from .hostsync import host_allgather
+        g = host_allgather(totals, "telemetry/phase_skew")  # [P, L]
     else:
         g = totals[None, :]
     return {lb: {"min": float(g[:, i].min()),
@@ -110,20 +115,14 @@ def sync_bin_mappers(mappers: List) -> List:
 
     if jax.process_count() <= 1:
         return mappers
-    from jax.experimental import multihost_utils
     from ..ops.binning import BinMapper
+    from .hostsync import host_broadcast_bytes
 
-    payload = json.dumps([m.to_dict() for m in mappers]).encode()
-    # length-prefix so every process allocates the same buffer; only
-    # process 0's bytes matter (and only they fit the broadcast size —
-    # other ranks' serializations can be longer)
-    n = np.asarray([len(payload)], np.int32)
-    n = multihost_utils.broadcast_one_to_all(n)
-    buf = np.zeros(int(n[0]), np.uint8)
+    payload = None
     if jax.process_index() == 0:
-        buf[: len(payload)] = np.frombuffer(payload, np.uint8)
-    buf = multihost_utils.broadcast_one_to_all(buf)
-    dicts = json.loads(bytes(buf.tobytes()).decode())
+        payload = json.dumps([m.to_dict() for m in mappers]).encode()
+    buf = host_broadcast_bytes(payload, "spmd/sync_bin_mappers")
+    dicts = json.loads(buf.decode())
     return [BinMapper.from_dict(d) for d in dicts]
 
 
@@ -155,16 +154,16 @@ def distributed_dataset(X, label=None, params: Optional[dict] = None,
 
     if jax.process_count() <= 1:
         return ds
-    from jax.experimental import multihost_utils
-
     from ..basic import LightGBMError
     from ..ops.binning import bin_values
+    from .hostsync import host_allgather
 
-    # process_allgather on unequal shard shapes fails with an opaque
-    # XLA shape error (or hangs); check the tiny n_local vector first
-    # and name the mismatched ranks
-    n_locals = np.asarray(multihost_utils.process_allgather(
-        np.asarray([ds.num_data()], np.int64))).reshape(-1)
+    # an allgather on unequal shard shapes fails with an opaque shape
+    # error (or hangs); check the tiny n_local vector first and name
+    # the mismatched ranks
+    n_locals = host_allgather(
+        np.asarray([ds.num_data()], np.int64),
+        "spmd/dataset_row_counts").reshape(-1)
     if len(set(n_locals.tolist())) > 1:
         detail = ", ".join(
             f"rank {r}: {int(n)} rows" for r, n in enumerate(n_locals))
@@ -178,23 +177,23 @@ def distributed_dataset(X, label=None, params: Optional[dict] = None,
     cols = [Xf[:, j] for j in ds._used_features]
     local_bins = bin_values(cols, ds.mappers)
 
-    def gather_rows(a, dtype):
+    def gather_rows(a, dtype, what="rows"):
         if a is None:
             return None
         a = np.asarray(a, dtype)
-        g = multihost_utils.process_allgather(a)   # [P, n_local, ...]
+        g = host_allgather(a, f"spmd/dataset_{what}")  # [P, n_local, ...]
         return np.concatenate(list(g), axis=0)
 
-    ds._bins = gather_rows(local_bins, local_bins.dtype)
+    ds._bins = gather_rows(local_bins, local_bins.dtype, "bins")
     ds._device_bins = None
     ds._n = ds._bins.shape[0]
-    ds.label = gather_rows(ds.label, np.float64)
-    ds.weight = gather_rows(ds.weight, np.float64)
-    ds.init_score = gather_rows(ds.init_score, np.float64)
-    ds.position = gather_rows(ds.position, np.int32)
+    ds.label = gather_rows(ds.label, np.float64, "label")
+    ds.weight = gather_rows(ds.weight, np.float64, "weight")
+    ds.init_score = gather_rows(ds.init_score, np.float64, "init_score")
+    ds.position = gather_rows(ds.position, np.int32, "position")
     if ds.group is not None:
-        g = multihost_utils.process_allgather(
-            np.asarray(ds.group, np.int32))
+        g = host_allgather(np.asarray(ds.group, np.int32),
+                           "spmd/dataset_group")
         ds.group = np.concatenate(list(g), axis=0)
         # rebuild the query boundaries for the GLOBAL row set (the
         # shard-local ones from construct() cover only n_local rows)

@@ -16,24 +16,40 @@ actually hit.
 - SPMD sanity guard — :func:`~lightgbm_tpu.parallel.spmd.
   verify_step_consistency` turns silent multi-process divergence into a
   clear ``LightGBMError``.
+- :mod:`~lightgbm_tpu.resilience.watchdog` — collective watchdog:
+  every host-level sync point of a multi-process run carries a
+  deadline, so a rank that dies or stalls mid-collective surfaces as a
+  ``LightGBMError`` naming the collective instead of an infinite hang.
+- :mod:`~lightgbm_tpu.resilience.elastic` — the supervised restart
+  driver (``python -m lightgbm_tpu launch N -- <cmd>``): spawns one
+  training process per rank, detects rank death / watchdog aborts,
+  and relaunches the world resuming from the newest checkpoint.
+- ``init_distributed`` retries its coordinator handshake with
+  jittered exponential backoff (parallel/distributed.py) —
+  ``init_retries`` / ``init_backoff_seconds`` registry counters.
 - :mod:`~lightgbm_tpu.resilience.faults` — the deterministic
   ``LIGHTGBM_TPU_FAULT_INJECT`` harness the tests drive all of the
-  above with.
+  above with (including the distributed kinds ``rank_kill`` /
+  ``stall_rank`` / ``init_refuse``).
 
 Every fault surfaces as a ``{"event": "fault", ...}`` line in the
 telemetry JSONL stream (docs/OBSERVABILITY.md) and a
 ``fault_events{kind=...}`` registry counter. See docs/RESILIENCE.md.
 """
 
+from . import watchdog
 from .checkpoint import (Checkpoint, CheckpointError, checkpoint,
                          list_snapshots, load_latest_snapshot,
                          load_snapshot, restore_booster, snapshot_path,
                          write_snapshot)
-from .faults import FaultPlan, InjectedResourceExhausted, is_resource_exhausted
+from .faults import (FaultPlan, InjectedInitRefused,
+                     InjectedResourceExhausted, is_resource_exhausted,
+                     record_fault_event)
 
 __all__ = [
     "checkpoint", "Checkpoint", "CheckpointError", "snapshot_path",
     "write_snapshot", "load_snapshot", "load_latest_snapshot",
     "list_snapshots", "restore_booster",
-    "FaultPlan", "InjectedResourceExhausted", "is_resource_exhausted",
+    "FaultPlan", "InjectedResourceExhausted", "InjectedInitRefused",
+    "is_resource_exhausted", "record_fault_event", "watchdog",
 ]

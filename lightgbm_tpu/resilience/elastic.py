@@ -1,0 +1,295 @@
+"""Supervised elastic restart: ``python -m lightgbm_tpu launch``.
+
+The missing half of distributed fault tolerance: the collective
+watchdog (resilience/watchdog.py) turns a hung world into per-rank
+*errors*, and the checkpoint layer (resilience/checkpoint.py) makes the
+training state durable — but something still has to notice dead
+workers, tear down the survivors, and bring the world back up. That is
+this supervisor::
+
+    python -m lightgbm_tpu launch 4 -- python train.py
+
+It spawns one training subprocess per rank with the coordinator
+environment pre-wired (``LIGHTGBM_TPU_COORDINATOR`` /
+``LIGHTGBM_TPU_NUM_PROCS`` / ``LIGHTGBM_TPU_RANK`` — a bare
+``init_distributed()`` in the training script picks them up), watches
+for any rank exiting nonzero (a crash, or a surviving rank's watchdog
+abort), kills the rest of the world, and relaunches everything on a
+fresh coordinator port. With ``LIGHTGBM_TPU_CHECKPOINT`` exported (or
+``--checkpoint-dir``), every relaunch auto-resumes from the newest
+snapshot, so the restarted run converges to the same model an
+uninterrupted run produces (docs/RESILIENCE.md "Distributed
+failures").
+
+One-shot injected faults (``rank_kill`` / ``stall_rank`` in
+``LIGHTGBM_TPU_FAULT_INJECT``) are stripped from the environment on
+relaunch — consume-on-fire cannot survive a process restart, and
+without stripping the injected failure would recur every generation
+forever.
+
+This module (and the whole ``launch`` dispatch in ``__main__``) never
+imports jax: the supervisor must stay alive and tiny while worlds die
+around it, and must not pin accelerator devices the workers need.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..utils.log import log_info, log_warning
+
+__all__ = ["main", "supervise", "worker_env", "strip_one_shot_faults"]
+
+#: fault kinds that must not re-fire after a supervised restart
+_ONE_SHOT_KINDS = ("rank_kill", "stall_rank")
+
+_POLL_SECONDS = 0.2
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _kill_group(proc: subprocess.Popen) -> None:
+    """SIGKILL a worker's whole process group (workers run in their own
+    session); fall back to killing the process alone."""
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.kill()
+        except OSError:
+            pass
+
+
+def strip_one_shot_faults(spec: str) -> str:
+    """Drop ``rank_kill``/``stall_rank`` tokens from a
+    ``LIGHTGBM_TPU_FAULT_INJECT`` value for a relaunch."""
+    kept = [tok for tok in spec.split(",")
+            if tok.strip()
+            and tok.split("@", 1)[0].strip() not in _ONE_SHOT_KINDS]
+    return ",".join(kept)
+
+
+def worker_env(base: Dict[str, str], rank: int, nprocs: int,
+               port: int, generation: int = 0) -> Dict[str, str]:
+    """The per-rank environment one generation of workers runs with."""
+    env = dict(base)
+    env["LIGHTGBM_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+    env["LIGHTGBM_TPU_NUM_PROCS"] = str(nprocs)
+    env["LIGHTGBM_TPU_RANK"] = str(rank)
+    env["LIGHTGBM_TPU_RESTART_COUNT"] = str(generation)
+    if generation > 0 and env.get("LIGHTGBM_TPU_FAULT_INJECT"):
+        env["LIGHTGBM_TPU_FAULT_INJECT"] = strip_one_shot_faults(
+            env["LIGHTGBM_TPU_FAULT_INJECT"])
+    return env
+
+
+def _launch_generation(cmd: Sequence[str], nprocs: int, port: int,
+                       generation: int, log_dir: str,
+                       base_env: Dict[str, str]) -> List[subprocess.Popen]:
+    procs = []
+    try:
+        for rank in range(nprocs):
+            log_path = os.path.join(
+                log_dir, f"elastic_g{generation}_rank{rank}.log")
+            log_file = open(log_path, "ab")
+            try:
+                procs.append(subprocess.Popen(
+                    list(cmd),
+                    env=worker_env(base_env, rank, nprocs, port,
+                                   generation),
+                    stdout=log_file, stderr=subprocess.STDOUT,
+                    start_new_session=True))
+            finally:
+                log_file.close()   # the child holds its own fd now
+    except BaseException:
+        # a mid-loop failure (EMFILE, deleted log dir) must not leave
+        # the already-spawned ranks orphaned, waiting on peers that
+        # will never come up
+        for p in procs:
+            _kill_group(p)
+        raise
+    return procs
+
+
+def _wait_generation(procs: List[subprocess.Popen],
+                     grace: float) -> int:
+    """Block until the generation resolves: 0 when every rank exited
+    cleanly, else the first nonzero exit code (the rest of the world is
+    killed after ``grace`` seconds — survivors are either hung in a
+    collective or about to watchdog-abort; their state is already
+    checkpointed)."""
+    while True:
+        first_bad: Optional[subprocess.Popen] = None
+        alive = 0
+        for p in procs:
+            rc = p.poll()
+            if rc is None:
+                alive += 1
+            elif rc != 0 and first_bad is None:
+                first_bad = p
+        if first_bad is not None:
+            rank = procs.index(first_bad)
+            rc = first_bad.returncode
+            log_warning(f"elastic: rank {rank} exited with code "
+                        f"{rc}; stopping the world")
+            deadline = time.monotonic() + max(0.0, grace)
+            while time.monotonic() < deadline and any(
+                    p.poll() is None for p in procs):
+                time.sleep(_POLL_SECONDS)
+            for p in procs:
+                if p.poll() is None:
+                    _kill_group(p)
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    _kill_group(p)
+            # signal deaths carry a NEGATIVE returncode; surface them
+            # shell-style (128+signum) so SystemExit doesn't truncate
+            # -9 into an unrelated 247
+            return (128 - rc) if rc and rc < 0 else (rc or 1)
+        if alive == 0:
+            return 0
+        time.sleep(_POLL_SECONDS)
+
+
+def supervise(nprocs: int, cmd: Sequence[str], max_restarts: int = 3,
+              port: Optional[int] = None, log_dir: str = ".",
+              grace: float = 5.0,
+              env: Optional[Dict[str, str]] = None) -> int:
+    """Run ``cmd`` as an ``nprocs``-rank world under supervision;
+    returns the final exit code (0 = a generation completed cleanly).
+
+    Each generation gets a fresh coordinator port — the previous
+    coordinator died with its rank-0 worker, and its socket may linger
+    in TIME_WAIT. Worker output goes to
+    ``{log_dir}/elastic_g{generation}_rank{rank}.log``.
+    """
+    if nprocs < 1:
+        raise ValueError("nprocs must be >= 1")
+    if not cmd:
+        raise ValueError("no worker command given (pass it after --)")
+    base_env = dict(os.environ if env is None else env)
+    os.makedirs(log_dir, exist_ok=True)
+    generation = 0
+    while True:
+        gen_port = port if port else _free_port()
+        log_info(f"elastic: generation {generation}: launching "
+                 f"{nprocs} rank(s), coordinator 127.0.0.1:{gen_port}")
+        procs = _launch_generation(cmd, nprocs, gen_port, generation,
+                                   log_dir, base_env)
+        try:
+            rc = _wait_generation(procs, grace)
+        except BaseException:   # ctrl-C etc.: never leak a world
+            for p in procs:
+                if p.poll() is None:
+                    _kill_group(p)
+            raise
+        if rc == 0:
+            log_info(f"elastic: generation {generation} completed "
+                     "cleanly")
+            return 0
+        if generation >= max_restarts:
+            log_warning(
+                f"elastic: generation {generation} failed (exit {rc}) "
+                f"and the restart budget ({max_restarts}) is spent — "
+                "giving up")
+            return rc
+        generation += 1
+        try:
+            from ..obs.registry import registry
+            registry.counter("elastic_restarts").inc()
+        except Exception:
+            pass
+        log_info(f"elastic: restarting the world (restart {generation}"
+                 f"/{max_restarts}); training resumes from the newest "
+                 "checkpoint if LIGHTGBM_TPU_CHECKPOINT is set")
+
+
+_HELP_EPILOG = """\
+The worker command runs once per rank with LIGHTGBM_TPU_COORDINATOR /
+LIGHTGBM_TPU_NUM_PROCS / LIGHTGBM_TPU_RANK exported; a bare
+init_distributed() call inside it joins the world. Export
+LIGHTGBM_TPU_CHECKPOINT=<dir> (or pass --checkpoint-dir) so every
+restart resumes from the newest snapshot. See docs/RESILIENCE.md
+"Distributed failures".
+
+exit codes:
+  0  a generation completed cleanly on every rank
+  N  the last failing rank's exit code, once restarts are exhausted
+     (signal deaths surface shell-style as 128+signum, e.g. 137 for
+     SIGKILL)
+"""
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu launch",
+        usage="python -m lightgbm_tpu launch <nprocs> [options] "
+              "-- <worker cmd...>",
+        description="Supervised elastic launcher: spawn one training "
+                    "process per rank, restart the world from the "
+                    "newest checkpoint when a rank dies or a "
+                    "collective watchdog aborts.",
+        epilog=_HELP_EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("nprocs", type=int, help="number of ranks to spawn")
+    p.add_argument("--max-restarts", type=int, default=3,
+                   help="world restarts before giving up (default 3)")
+    p.add_argument("--port", type=int, default=0,
+                   help="fixed coordinator port (default: a fresh free "
+                        "port per generation)")
+    p.add_argument("--log-dir", default=".",
+                   help="directory for per-rank worker logs "
+                        "(default: .)")
+    p.add_argument("--grace", type=float, default=5.0,
+                   help="seconds to let surviving ranks exit on their "
+                        "own before killing them (default 5)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="export LIGHTGBM_TPU_CHECKPOINT=<dir> to the "
+                        "workers (auto-checkpoint + auto-resume)")
+    # NOTE: the worker command is NOT an argparse positional — a
+    # REMAINDER positional swallows the supervisor's own options, so
+    # main() splits on the `--` separator before parsing
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # split on the `--` separator OURSELVES: argparse's REMAINDER is
+    # greedy and would swallow the supervisor's own options into the
+    # worker command
+    if "--" in argv:
+        split = argv.index("--")
+        head, cmd = argv[:split], argv[split + 1:]
+    else:
+        head, cmd = argv, []
+    args = build_parser().parse_args(head)
+    if not cmd:
+        print("launch: no worker command given (usage: launch <nprocs> "
+              "-- <cmd...>)", file=sys.stderr)
+        return 2
+    env = dict(os.environ)
+    if args.checkpoint_dir:
+        env["LIGHTGBM_TPU_CHECKPOINT"] = args.checkpoint_dir
+    try:
+        return supervise(args.nprocs, cmd,
+                         max_restarts=args.max_restarts,
+                         port=args.port or None, log_dir=args.log_dir,
+                         grace=args.grace, env=env)
+    except KeyboardInterrupt:
+        print("launch: interrupted", file=sys.stderr)
+        return 130

@@ -20,6 +20,24 @@ Kinds:
 - ``kill@N`` — ``SIGKILL`` the current process at the *start* of
   iteration ``N``, exercising checkpoint/auto-resume end to end.
 
+Distributed kinds (docs/RESILIENCE.md "Distributed failures"; the same
+``LIGHTGBM_TPU_FAULT_INJECT`` value is typically exported world-wide by
+the launch supervisor, so these are additionally gated on
+``LIGHTGBM_TPU_FAULT_RANK`` — a comma list of process indices, default
+``0`` — and only fire on the matching rank):
+
+- ``rank_kill@N`` — ``SIGKILL`` the selected rank at the start of
+  iteration ``N``; the *surviving* ranks then hang in their next host
+  collective, which the watchdog (resilience/watchdog.py) converts
+  into a ``LightGBMError`` within its deadline.
+- ``stall_rank@N`` — the selected rank sleeps forever at the start of
+  iteration ``N`` (the straggler / swap-storm failure mode: the
+  process is alive, so no transport error ever surfaces — only the
+  watchdog deadline catches it).
+- ``init_refuse@K`` — ``init_distributed`` raises a synthetic
+  connection-refused error on its first ``K`` attempts (coordinator
+  not up yet), exercising the retry/backoff loop; fires on every rank.
+
 A missing / empty variable parses to an inert plan: every query is a
 cheap tuple-membership test, nothing touches jax, and production runs
 pay nothing.
@@ -29,16 +47,63 @@ from __future__ import annotations
 
 import os
 import signal
+import time
 from typing import Dict, List, Tuple
 
-__all__ = ["FaultPlan", "InjectedResourceExhausted", "is_resource_exhausted"]
+__all__ = ["FaultPlan", "InjectedResourceExhausted", "InjectedInitRefused",
+           "is_resource_exhausted", "append_fault_event",
+           "record_fault_event", "FAULT_EVENTS"]
 
-_KNOWN_KINDS = ("nan_grad", "nan_hess", "oom", "kill")
+_KNOWN_KINDS = ("nan_grad", "nan_hess", "oom", "kill",
+                "rank_kill", "stall_rank", "init_refuse")
+
+#: process-level fault event log for faults that have no engine to hang
+#: off (init retries, watchdog timeouts, distributed injections). The
+#: telemetry recorder drains it into the JSONL stream alongside the
+#: engine ``fault_log``s; capped like them so an undrained process
+#: cannot grow it forever.
+FAULT_EVENTS: List[dict] = []
+
+
+def append_fault_event(log: List[dict], kind: str, iteration: int,
+                       action: str, detail: str) -> None:
+    """THE fault-event writer: append one ``{"event": "fault"}``
+    JSONL-shaped event to ``log`` (capped at 512 so an undrained log
+    cannot grow forever), count it in the ``fault_events{kind}``
+    registry counter, and warn. Both the engine's per-booster
+    ``fault_log`` (``GBDTBooster._record_fault``) and the process-level
+    :data:`FAULT_EVENTS` go through here, so the recorder drains one
+    schema."""
+    if len(log) >= 512:
+        del log[0]
+    log.append({
+        "event": "fault", "kind": kind, "iteration": int(iteration),
+        "action": action, "detail": detail, "time": time.time()})
+    try:
+        from ..obs.registry import registry
+        registry.counter("fault_events", kind=kind).inc()
+    except Exception:
+        pass
+    from ..utils.log import log_warning
+    log_warning(f"fault[{kind}] at iteration {iteration}: {detail}"
+                + (f" -> {action}" if action else ""))
+
+
+def record_fault_event(kind: str, iteration: int = -1, action: str = "",
+                       detail: str = "") -> None:
+    """Process-level fault event (no engine in scope): goes to
+    :data:`FAULT_EVENTS`."""
+    append_fault_event(FAULT_EVENTS, kind, iteration, action, detail)
 
 
 class InjectedResourceExhausted(RuntimeError):
     """Synthetic stand-in for jaxlib's ``XlaRuntimeError`` OOM: carries
     the same ``RESOURCE_EXHAUSTED`` marker the classifier keys on."""
+
+
+class InjectedInitRefused(RuntimeError):
+    """Synthetic coordinator-not-up failure: carries the ``connection
+    refused`` marker ``init_distributed``'s retry classifier keys on."""
 
 
 def is_resource_exhausted(exc: BaseException) -> bool:
@@ -75,6 +140,8 @@ class FaultPlan:
             self._events.setdefault(kind, []).append(int(it))
         for lst in self._events.values():
             lst.sort()
+        # init_refuse@K: refuse the first K connection attempts
+        self._init_refusals_left = sum(self._events.get("init_refuse", ()))
 
     @classmethod
     def from_env(cls) -> "FaultPlan":
@@ -115,3 +182,52 @@ class FaultPlan:
         survive."""
         if self.take("kill", iteration):
             os.kill(os.getpid(), signal.SIGKILL)
+
+    # -- distributed kinds (rank-gated; docs/RESILIENCE.md) ------------
+    @staticmethod
+    def _rank_selected() -> bool:
+        """Is THIS process one of the fault-target ranks
+        (``LIGHTGBM_TPU_FAULT_RANK``, comma list, default ``0``)? The
+        process index is only queried when a distributed kind is
+        actually armed, so inert plans never touch jax."""
+        targets = {int(r) for r in
+                   os.environ.get("LIGHTGBM_TPU_FAULT_RANK",
+                                  "0").split(",") if r.strip()}
+        try:
+            import jax
+            me = jax.process_index()
+        except Exception:
+            me = 0
+        return me in targets
+
+    def maybe_distributed_fault(self, iteration: int) -> None:
+        """Fire ``rank_kill`` / ``stall_rank`` if armed for this
+        iteration and this process is a selected rank. ``rank_kill``
+        SIGKILLs (like ``kill``); ``stall_rank`` records a fault event
+        and then sleeps forever — the straggler the peers' collective
+        watchdog must catch, because no transport error will."""
+        if self.fires("rank_kill", iteration) and self._rank_selected():
+            self.take("rank_kill", iteration)
+            os.kill(os.getpid(), signal.SIGKILL)
+        if self.fires("stall_rank", iteration) and self._rank_selected():
+            self.take("stall_rank", iteration)
+            record_fault_event(
+                "stall_rank", iteration=iteration, action="stall",
+                detail="injected infinite stall "
+                       "(LIGHTGBM_TPU_FAULT_INJECT)")
+            while True:
+                time.sleep(3600.0)
+
+    def maybe_refuse_init(self) -> None:
+        """Raise one synthetic connection-refused error per remaining
+        ``init_refuse`` budget — the coordinator-not-up failure
+        ``init_distributed``'s retry loop must absorb."""
+        if self._init_refusals_left > 0:
+            self._init_refusals_left -= 1
+            record_fault_event(
+                "init_refuse", action="retry",
+                detail="injected coordinator connection refusal "
+                       "(LIGHTGBM_TPU_FAULT_INJECT)")
+            raise InjectedInitRefused(
+                "connection refused: injected coordinator-not-up "
+                "failure (LIGHTGBM_TPU_FAULT_INJECT)")

@@ -1,0 +1,19 @@
+# tpulint fixture: TPL006 negative — the watchdog idiom done right:
+# state copied under the lock, the collective dispatched outside it.
+import threading
+
+import jax.numpy as jnp
+
+_lock = threading.Lock()
+_heartbeat = {"t": 0.0}
+
+
+def guarded_sync(values):
+    total = jnp.sum(values)          # dispatch outside any lock
+    with _lock:
+        _heartbeat["t"] = float(total)
+
+
+def read_heartbeat():
+    with _lock:
+        return dict(_heartbeat)

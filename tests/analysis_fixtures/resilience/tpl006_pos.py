@@ -1,0 +1,30 @@
+# tpulint fixture: TPL006 positive — lock held across a collective in
+# the resilience layer. The watchdog's contract is copy-under-lock,
+# dispatch-outside: a bookkeeping lock held across a collective would
+# hang the abort path that exists to break hangs.
+import threading
+
+import jax
+import jax.numpy as jnp
+
+_lock = threading.Lock()
+_heartbeat = {"t": 0.0}
+
+
+def guarded_sync(values):
+    with _lock:
+        # EXPECT: TPL006
+        total = jnp.sum(values)      # collective while holding _lock
+        _heartbeat["t"] = float(total)
+
+
+class Watchdog:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.last = None
+
+    def run(self, x):
+        with self._lock:
+            # EXPECT: TPL006
+            y = jax.device_put(x)
+            self.last = y

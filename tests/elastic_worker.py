@@ -1,0 +1,84 @@
+"""Worker for the distributed chaos tests
+(test_distributed_resilience.py) and the launch-supervisor end-to-end
+proof.
+
+Run as one rank of a ``python -m lightgbm_tpu launch`` world (or
+spawned directly by a test): all wiring comes from the environment —
+
+- ``LIGHTGBM_TPU_COORDINATOR`` / ``LIGHTGBM_TPU_NUM_PROCS`` /
+  ``LIGHTGBM_TPU_RANK`` — picked up by a bare ``init_distributed()``,
+- ``LIGHTGBM_TPU_CHECKPOINT`` — auto-checkpoint + auto-resume,
+- ``LIGHTGBM_TPU_TELEMETRY`` — JSONL event stream (rank 0 writes),
+- ``LIGHTGBM_TPU_FAULT_INJECT`` (+ ``LIGHTGBM_TPU_FAULT_RANK``) —
+  rank_kill / stall_rank / init_refuse chaos,
+- ``LIGHTGBM_TPU_COLLECTIVE_TIMEOUT`` — watchdog deadline.
+
+Each rank loads its half of a fixed dataset through
+``distributed_dataset`` (bin-mapper sync + row allgather over the host
+transport) and trains the replicated model with the serial learner —
+each process computes on its own devices, and the cross-rank surface
+is exactly the host-level sync points the watchdog guards. Rank 0
+saves the model; every rank prints ``INIT_RETRIES=<n>`` after joining
+and ``rank <r> DONE`` on success. Any LightGBMError (a watchdog abort)
+prints ``WORKER ABORT: <msg>`` and hard-exits 13 — ``os._exit``, so a
+hung collective left on a daemon thread can never block process
+death.
+
+Usage: python elastic_worker.py <outdir> [num_rounds]
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+outdir = sys.argv[1]
+num_rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+
+from lightgbm_tpu.parallel.distributed import init_distributed  # noqa: E402
+
+init_distributed()   # supervisor env (or single-process no-op)
+
+from lightgbm_tpu.obs.registry import registry  # noqa: E402
+
+print(f"INIT_RETRIES={int(registry.counter('init_retries').value)}",
+      flush=True)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.basic import LightGBMError  # noqa: E402
+from lightgbm_tpu.parallel import spmd  # noqa: E402
+
+rank = jax.process_index()
+nproc = jax.process_count()
+
+rs = np.random.RandomState(7)
+n, f = 600, 5
+X = rs.randn(n, f)
+y = X @ rs.randn(f) + 0.05 * rs.randn(n)
+shard = n // max(nproc, 1)
+lo, hi = rank * shard, (rank + 1) * shard
+
+try:
+    ds = spmd.distributed_dataset(X[lo:hi], label=y[lo:hi],
+                                  params={"verbosity": -1})
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "seed": 3,
+                     "verbosity": -1}, ds, num_boost_round=num_rounds)
+except LightGBMError as e:
+    print(f"WORKER ABORT: {e}", flush=True)
+    os._exit(13)
+
+if rank == 0:
+    bst.save_model(os.path.join(outdir, "model_elastic.txt"))
+print(f"rank {rank} DONE iterations={bst.current_iteration()}",
+      flush=True)
+# skip jax.distributed atexit teardown: with peers already dead it can
+# block on the coordination service instead of exiting
+sys.stdout.flush()
+os._exit(0)

@@ -1,0 +1,498 @@
+"""Distributed resilience: collective watchdog, init retry/backoff,
+elastic launch supervisor, distributed fault kinds.
+
+Three layers:
+
+1. fast unit tests — watchdog deadlines/passthrough, init retry with
+   injected refusals (monkeypatched ``jax.distributed.initialize``),
+   ``parse_machines`` edge cases, FaultPlan distributed kinds, the
+   supervisor restart loop with jax-free workers, telemetry
+   truncation tolerance;
+2. subprocess regression — ``kill@N`` mid-iteration with telemetry on:
+   the stream must re-parse;
+3. chaos tests (``slow`` + ``mp``) — a real 2-process world over the
+   kv host transport: ``stall_rank`` makes the surviving rank raise a
+   watchdog ``LightGBMError`` naming the stuck collective (no hang, no
+   orphans), and ``python -m lightgbm_tpu launch`` survives
+   ``rank_kill`` + ``init_refuse``, restarting from the newest
+   checkpoint to a model byte-identical to an uninterrupted run.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import lightgbm_tpu  # noqa: F401  (repo-root sys.path via conftest)
+from _mp_utils import (REPO_DIR, TESTS_DIR, free_port, kill_group,
+                       spawn_worker, worker_base_env)
+from lightgbm_tpu.basic import LightGBMError
+from lightgbm_tpu.obs.recorder import summarize_events
+from lightgbm_tpu.obs.registry import registry
+from lightgbm_tpu.resilience import watchdog
+from lightgbm_tpu.resilience.elastic import (strip_one_shot_faults,
+                                             supervise, worker_env)
+from lightgbm_tpu.resilience.faults import (FAULT_EVENTS, FaultPlan,
+                                            InjectedInitRefused)
+from lightgbm_tpu.parallel import distributed
+from lightgbm_tpu.parallel.distributed import (init_distributed,
+                                               parse_machines)
+
+pytestmark = pytest.mark.mp
+
+
+# ---------------------------------------------------------------------
+# watchdog unit tests (single process; guarded() itself is jax-free)
+# ---------------------------------------------------------------------
+
+def test_watchdog_passthrough_and_heartbeat():
+    assert watchdog.guarded("t/ok", lambda: {"x": 1}, deadline=5.0,
+                            iteration=4, world=2) == {"x": 1}
+    heard = watchdog.last_heard()
+    assert heard["name"] == "t/ok"
+    assert heard["iteration"] == 4
+    assert heard["world"] == 2
+
+
+def test_watchdog_timeout_names_collective_and_counts():
+    before = registry.counter("collective_timeouts").value
+    FAULT_EVENTS.clear()
+    with pytest.raises(LightGBMError) as ei:
+        watchdog.guarded("telemetry/verify_step", time.sleep, 10,
+                         iteration=12, deadline=0.2)
+    msg = str(ei.value)
+    assert "telemetry/verify_step" in msg
+    assert "iteration 12" in msg
+    assert "deadline" in msg
+    assert registry.counter("collective_timeouts").value == before + 1
+    kinds = [e["kind"] for e in FAULT_EVENTS]
+    assert "collective_timeout" in kinds
+
+
+def test_watchdog_wraps_transport_error_but_not_lgbm_error():
+    def boom():
+        raise RuntimeError("connection reset by peer")
+
+    with pytest.raises(LightGBMError) as ei:
+        watchdog.guarded("spmd/sync_bin_mappers", boom, deadline=5.0)
+    assert "spmd/sync_bin_mappers" in str(ei.value)
+    assert isinstance(ei.value.__cause__, RuntimeError)
+
+    def diverged():
+        raise LightGBMError("SPMD divergence: ranks disagree")
+
+    with pytest.raises(LightGBMError) as ei:
+        watchdog.guarded("spmd/verify_step", diverged, deadline=5.0)
+    # the collective's own LightGBMError passes through unwrapped
+    assert str(ei.value) == "SPMD divergence: ranks disagree"
+
+
+def test_watchdog_deadline_resolution(monkeypatch):
+    monkeypatch.delenv("LIGHTGBM_TPU_COLLECTIVE_TIMEOUT", raising=False)
+    watchdog.configure(None)
+    assert watchdog.deadline_seconds() == \
+        watchdog.DEFAULT_DEADLINE_SECONDS
+    watchdog.configure(42.0)
+    assert watchdog.deadline_seconds() == 42.0
+    monkeypatch.setenv("LIGHTGBM_TPU_COLLECTIVE_TIMEOUT", "7.5")
+    assert watchdog.deadline_seconds() == 7.5   # env wins
+    watchdog.configure(None)
+
+
+def test_watchdog_config_field_parses():
+    from lightgbm_tpu.config import Config
+    cfg = Config.from_params({"collective_timeout_sec": "12.5"})
+    assert cfg.collective_timeout_sec == 12.5
+    with pytest.raises(ValueError):
+        Config.from_params({"collective_timeout_sec": -1})
+
+
+# ---------------------------------------------------------------------
+# parse_machines edge cases + init_distributed arg validation
+# ---------------------------------------------------------------------
+
+def test_parse_machines_string_formats():
+    assert parse_machines(machines="a:1,b:2") == [("a", 1), ("b", 2)]
+    # whitespace, blank entries, newlines as separators
+    assert parse_machines(machines=" a:1 , ,\n b:2 ,, ") == \
+        [("a", 1), ("b", 2)]
+    assert parse_machines(machines="") == []
+    assert parse_machines() == []
+
+
+def test_parse_machines_file_formats(tmp_path):
+    # 'host port', 'host:port', blank + whitespace-only lines,
+    # multi-space separators
+    mlist = tmp_path / "mlist.txt"
+    mlist.write_text("10.0.0.1 12400\n\n   \n10.0.0.2:12401\n"
+                     "  10.0.0.3   12402  \n")
+    assert parse_machines(machine_list_file=str(mlist)) == [
+        ("10.0.0.1", 12400), ("10.0.0.2", 12401), ("10.0.0.3", 12402)]
+
+
+def test_parse_machines_port_defaults_and_errors():
+    assert parse_machines(machines="justhost") == [("justhost", 0)]
+    with pytest.raises(ValueError, match="bad port"):
+        parse_machines(machines="host:notaport")
+    with pytest.raises(ValueError, match="bad machine-list entry"):
+        parse_machines(machines="a:1:2")
+
+
+def test_single_entry_machine_list_is_noop(monkeypatch):
+    # num_machines=1: must return without touching jax.distributed
+    import jax
+
+    def forbid(**kwargs):
+        raise AssertionError("initialize called for a 1-machine list")
+
+    monkeypatch.setattr(jax.distributed, "initialize", forbid)
+    monkeypatch.setattr(distributed, "_INITIALIZED", False)
+    init_distributed(machines="localhost:12400")
+    assert distributed._INITIALIZED is False
+
+
+def test_missing_rank_raises(monkeypatch):
+    monkeypatch.setattr(distributed, "_INITIALIZED", False)
+    monkeypatch.delenv("LIGHTGBM_TPU_RANK", raising=False)
+    with pytest.raises(ValueError, match="local_rank"):
+        init_distributed(machines="a:1,b:2")
+
+
+# ---------------------------------------------------------------------
+# init retry / backoff (monkeypatched initialize — no real network)
+# ---------------------------------------------------------------------
+
+def test_init_retry_succeeds_after_injected_refusals(monkeypatch):
+    import jax
+
+    calls = []
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda **kw: calls.append(kw))
+    monkeypatch.setattr(distributed, "_INITIALIZED", False)
+    monkeypatch.setenv("LIGHTGBM_TPU_FAULT_INJECT", "init_refuse@2")
+    monkeypatch.setenv("LIGHTGBM_TPU_INIT_BACKOFF", "0.01")
+    before = registry.counter("init_retries").value
+    init_distributed(coordinator_address="127.0.0.1:1",
+                     num_processes=2, process_id=0)
+    assert distributed._INITIALIZED is True
+    assert len(calls) == 1   # real initialize ran once, after refusals
+    # acceptance: init_retries == K for init_refuse@K
+    assert registry.counter("init_retries").value == before + 2
+    assert registry.counter("init_backoff_seconds").value > 0
+    monkeypatch.setattr(distributed, "_INITIALIZED", False)
+
+
+def test_init_retries_exhausted_raises(monkeypatch):
+    import jax
+
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda **kw: (_ for _ in ()).throw(AssertionError("unreached")))
+    monkeypatch.setattr(distributed, "_INITIALIZED", False)
+    monkeypatch.setenv("LIGHTGBM_TPU_FAULT_INJECT", "init_refuse@99")
+    monkeypatch.setenv("LIGHTGBM_TPU_INIT_BACKOFF", "0.001")
+    monkeypatch.setenv("LIGHTGBM_TPU_INIT_RETRIES", "3")
+    with pytest.raises(LightGBMError, match="4 attempts"):
+        init_distributed(coordinator_address="127.0.0.1:1",
+                         num_processes=2, process_id=0)
+    assert distributed._INITIALIZED is False
+
+
+def test_init_nonretryable_error_propagates(monkeypatch):
+    import jax
+
+    def bad(**kw):
+        raise RuntimeError("invalid coordinator address")
+
+    monkeypatch.setattr(jax.distributed, "initialize", bad)
+    monkeypatch.setattr(distributed, "_INITIALIZED", False)
+    monkeypatch.delenv("LIGHTGBM_TPU_FAULT_INJECT", raising=False)
+    with pytest.raises(RuntimeError, match="invalid coordinator"):
+        init_distributed(coordinator_address="127.0.0.1:1",
+                         num_processes=2, process_id=0)
+
+
+# ---------------------------------------------------------------------
+# FaultPlan distributed kinds
+# ---------------------------------------------------------------------
+
+def test_fault_plan_distributed_kinds_parse():
+    p = FaultPlan("rank_kill@3,stall_rank@5,init_refuse@2,nan_grad@1")
+    assert p.iters("rank_kill") == (3,)
+    assert p.iters("stall_rank") == (5,)
+    assert p._init_refusals_left == 2
+    with pytest.raises(ValueError, match="unknown fault-injection"):
+        FaultPlan("explode@3")
+
+
+def test_fault_plan_init_refusals_consume():
+    p = FaultPlan("init_refuse@2")
+    for _ in range(2):
+        with pytest.raises(InjectedInitRefused,
+                           match="connection refused"):
+            p.maybe_refuse_init()
+    p.maybe_refuse_init()   # budget spent: no-op
+    assert p._init_refusals_left == 0
+
+
+def test_fault_rank_gating(monkeypatch):
+    # this single process is rank 0; a fault targeted at rank 1 must
+    # not fire (and must not consume its token)
+    monkeypatch.setenv("LIGHTGBM_TPU_FAULT_RANK", "1")
+    p = FaultPlan("stall_rank@0")
+    p.maybe_distributed_fault(0)   # would sleep forever if mis-gated
+    assert p.iters("stall_rank") == (0,)
+    monkeypatch.setenv("LIGHTGBM_TPU_FAULT_RANK", "0,3")
+    assert FaultPlan._rank_selected() is True
+
+
+# ---------------------------------------------------------------------
+# elastic supervisor (jax-free workers: pure restart-loop logic)
+# ---------------------------------------------------------------------
+
+_FLAKY_WORKER = """\
+import os, sys
+marker = sys.argv[1]
+if os.environ["LIGHTGBM_TPU_RANK"] == "0" and not os.path.exists(marker):
+    open(marker, "w").close()
+    sys.exit(5)
+sys.exit(0)
+"""
+
+
+def test_supervisor_restarts_failed_world(tmp_path):
+    worker = tmp_path / "flaky.py"
+    worker.write_text(_FLAKY_WORKER)
+    marker = tmp_path / "marker"
+    rc = supervise(2, [sys.executable, str(worker), str(marker)],
+                   max_restarts=2, log_dir=str(tmp_path), grace=1.0,
+                   env=dict(os.environ))
+    assert rc == 0
+    # generation 0 failed, generation 1 succeeded — both logged
+    assert (tmp_path / "elastic_g0_rank0.log").exists()
+    assert (tmp_path / "elastic_g1_rank0.log").exists()
+    assert not (tmp_path / "elastic_g2_rank0.log").exists()
+
+
+def test_supervisor_exhausts_restart_budget(tmp_path):
+    worker = tmp_path / "fail.py"
+    worker.write_text("import sys; sys.exit(7)\n")
+    rc = supervise(1, [sys.executable, str(worker)], max_restarts=1,
+                   log_dir=str(tmp_path), grace=0.5,
+                   env=dict(os.environ))
+    assert rc == 7
+    assert (tmp_path / "elastic_g1_rank0.log").exists()
+
+
+def test_worker_env_wiring_and_fault_stripping():
+    base = {"LIGHTGBM_TPU_FAULT_INJECT":
+            "rank_kill@3,stall_rank@5,oom@2,init_refuse@1"}
+    g0 = worker_env(base, rank=1, nprocs=4, port=555, generation=0)
+    assert g0["LIGHTGBM_TPU_COORDINATOR"] == "127.0.0.1:555"
+    assert g0["LIGHTGBM_TPU_NUM_PROCS"] == "4"
+    assert g0["LIGHTGBM_TPU_RANK"] == "1"
+    assert g0["LIGHTGBM_TPU_FAULT_INJECT"] == base[
+        "LIGHTGBM_TPU_FAULT_INJECT"]   # generation 0 keeps the plan
+    g1 = worker_env(base, rank=0, nprocs=4, port=556, generation=1)
+    # one-shot distributed kinds must not re-fire after a restart
+    assert g1["LIGHTGBM_TPU_FAULT_INJECT"] == "oom@2,init_refuse@1"
+    assert strip_one_shot_faults("rank_kill@1") == ""
+
+
+def test_launch_cli_is_jax_free():
+    """The supervisor must never import jax: it outlives dying worker
+    worlds and must not pin the accelerator devices they need."""
+    code = ("import sys\n"
+            "from lightgbm_tpu.resilience.elastic import build_parser\n"
+            "text = build_parser().format_help()\n"
+            "assert 'exit codes' in text and '--max-restarts' in text\n"
+            "assert 'jax' not in sys.modules, 'launch imported jax!'\n")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO_DIR,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------
+# telemetry truncation (satellite): a killed writer must leave a
+# re-parseable stream
+# ---------------------------------------------------------------------
+
+def _iteration_event(i):
+    return {"event": "iteration", "iteration": i, "wall_time": 0.1 * i,
+            "phases": {}, "recompiles": {"delta": 0, "total": 0},
+            "hbm": {}, "tree": {"trees": 1, "leaves": 3,
+                                "split_gain_sum": 1.0}, "eval": {}}
+
+
+def test_summarize_tolerates_truncated_final_line(tmp_path):
+    path = tmp_path / "t.jsonl"
+    with open(path, "w") as fh:
+        for i in range(3):
+            fh.write(json.dumps(_iteration_event(i)) + "\n")
+        fh.write('{"event": "iteration", "iteration": 3, "wal')  # cut
+    summary = summarize_events(str(path))
+    assert summary["iterations"] == 3
+
+
+def test_summarize_still_rejects_mid_file_garbage(tmp_path):
+    path = tmp_path / "t.jsonl"
+    with open(path, "w") as fh:
+        fh.write(json.dumps(_iteration_event(0)) + "\n")
+        fh.write("NOT JSON AT ALL\n")
+        fh.write(json.dumps(_iteration_event(1)) + "\n")
+    with pytest.raises(ValueError):
+        summarize_events(str(path))
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(300)
+def test_kill_mid_iteration_leaves_parseable_stream(tmp_path):
+    """The regression the recorder-hardening satellite pins: SIGKILL
+    mid-train (kill@7) must never leave the JSONL stream unparseable —
+    whatever landed before the kill summarizes cleanly."""
+    telem = tmp_path / "run.jsonl"
+    env = worker_base_env({
+        "JAX_PLATFORMS": "cpu",
+        "LIGHTGBM_TPU_TELEMETRY": str(telem),
+        "LIGHTGBM_TPU_FAULT_INJECT": "kill@7",
+    })
+    proc = spawn_worker(
+        [os.path.join(TESTS_DIR, "ckpt_worker.py"),
+         str(tmp_path / "model.txt")], env)
+    out, _ = proc.communicate(timeout=240)
+    assert proc.returncode == -9, out.decode(errors="replace")
+    summary = summarize_events(str(telem))   # must not raise
+    assert 1 <= summary["iterations"] <= 7
+
+
+# ---------------------------------------------------------------------
+# chaos: real 2-process worlds over the kv host transport
+# ---------------------------------------------------------------------
+
+def _chaos_env(tmp_path, port, rank, fault="", fault_rank="1",
+               deadline="20"):
+    return worker_base_env({
+        "LIGHTGBM_TPU_COORDINATOR": f"127.0.0.1:{port}",
+        "LIGHTGBM_TPU_NUM_PROCS": "2",
+        "LIGHTGBM_TPU_RANK": str(rank),
+        "LIGHTGBM_TPU_CHECKPOINT": str(tmp_path / "ckpts"),
+        "LIGHTGBM_TPU_TELEMETRY": str(tmp_path / "telemetry.jsonl"),
+        "LIGHTGBM_TPU_FAULT_INJECT": fault,
+        "LIGHTGBM_TPU_FAULT_RANK": fault_rank,
+        "LIGHTGBM_TPU_COLLECTIVE_TIMEOUT": deadline,
+        "LIGHTGBM_TPU_INIT_BACKOFF": "0.05",
+    })
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_stalled_rank_aborts_survivor_within_deadline(tmp_path):
+    """stall_rank@2 on rank 1: the survivor must raise a watchdog
+    LightGBMError naming the stuck collective — no hang, no orphan
+    processes."""
+    port = free_port()
+    worker = os.path.join(TESTS_DIR, "elastic_worker.py")
+    procs = [
+        spawn_worker([worker, str(tmp_path)],
+                     _chaos_env(tmp_path, port, rank,
+                                fault="stall_rank@2", fault_rank="1",
+                                deadline="15"))
+        for rank in (0, 1)
+    ]
+    t0 = time.monotonic()
+    try:
+        out0, _ = procs[0].communicate(timeout=300)
+    except subprocess.TimeoutExpired:
+        from _mp_utils import drain_all
+        drain_all(procs, "survivor hung despite the watchdog")
+    elapsed = time.monotonic() - t0
+    text0 = out0.decode(errors="replace")
+    assert procs[0].returncode == 13, text0
+    assert "WORKER ABORT" in text0
+    # the error names the stuck collective and the silent rank
+    assert "spmd/verify_step" in text0, text0
+    assert "rank 1" in text0, text0
+    # "within the watchdog deadline": init+train+deadline, with CI slack
+    assert elapsed < 240, f"survivor took {elapsed:.0f}s to abort"
+    # the stalled rank is still alive (that is the failure mode);
+    # reap it so nothing leaks into the suite
+    assert procs[1].poll() is None, "stalled rank exited early?"
+    kill_group(procs[1])
+    procs[1].communicate(timeout=30)
+    # the fault stream recorded the timeout (rank 0 is the writer)
+    summary = summarize_events(str(tmp_path / "telemetry.jsonl"))
+    assert summary["faults"].get("collective_timeout", 0) >= 1
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_launch_supervisor_resumes_to_identical_model(tmp_path):
+    """End-to-end acceptance: `python -m lightgbm_tpu launch` survives
+    rank_kill@3 (+ init_refuse@2 on every rank), restarts the world
+    from the newest checkpoint, and the final model is byte-identical
+    to an uninterrupted supervised run. init_retries==2 is proved from
+    the worker logs."""
+    worker = os.path.join(TESTS_DIR, "elastic_worker.py")
+
+    def launch(outdir, fault):
+        outdir.mkdir()
+        env = worker_base_env({
+            "JAX_PLATFORMS": "cpu",
+            "LIGHTGBM_TPU_CHECKPOINT": str(outdir / "ckpts"),
+            "LIGHTGBM_TPU_TELEMETRY": str(outdir / "telemetry.jsonl"),
+            "LIGHTGBM_TPU_FAULT_INJECT": fault,
+            "LIGHTGBM_TPU_FAULT_RANK": "1",
+            "LIGHTGBM_TPU_COLLECTIVE_TIMEOUT": "15",
+            "LIGHTGBM_TPU_INIT_BACKOFF": "0.05",
+        })
+        proc = subprocess.run(
+            [sys.executable, "-m", "lightgbm_tpu", "launch", "2",
+             "--max-restarts", "2", "--log-dir", str(outdir),
+             # grace > watchdog deadline: the survivor must get to
+             # abort (and log) on its own before the world teardown
+             "--grace", "30", "--",
+             sys.executable, worker, str(outdir)],
+            env=env, cwd=REPO_DIR, capture_output=True, text=True,
+            timeout=540)
+        return proc
+
+    faulted = launch(tmp_path / "faulted",
+                     "rank_kill@3,init_refuse@2")
+    assert faulted.returncode == 0, (
+        f"supervised run failed:\n{faulted.stdout}\n{faulted.stderr}\n"
+        + _tail_logs(tmp_path / "faulted"))
+    g0_rank0 = (tmp_path / "faulted" / "elastic_g0_rank0.log").read_text()
+    g1_rank0 = (tmp_path / "faulted" / "elastic_g1_rank0.log").read_text()
+    # generation 0: every rank retried init exactly K=2 times...
+    assert "INIT_RETRIES=2" in g0_rank0
+    # ...and the survivor watchdog-aborted on the stuck collective
+    assert "WORKER ABORT" in g0_rank0
+    assert "spmd/verify_step" in g0_rank0
+    # generation 1 resumed and finished all 8 rounds
+    assert "rank 0 DONE iterations=8" in g1_rank0
+
+    clean = launch(tmp_path / "clean", "")
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    model_faulted = (tmp_path / "faulted" / "model_elastic.txt").read_bytes()
+    model_clean = (tmp_path / "clean" / "model_elastic.txt").read_bytes()
+    assert model_faulted == model_clean, (
+        "restarted world diverged from the uninterrupted run")
+
+
+def _tail_logs(d, limit=2000):
+    parts = []
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return "(no log dir)"
+    for name in names:
+        if name.startswith("elastic_g") and name.endswith(".log"):
+            try:
+                text = (d / name).read_text(errors="replace")
+            except OSError:
+                continue
+            parts.append(f"--- {name} ---\n{text[-limit:]}")
+    return "\n".join(parts)

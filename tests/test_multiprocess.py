@@ -7,74 +7,36 @@ IDENTICAL model a single process trains on the same 4-device mesh.
 This is the full multi-host path: coordinator wiring
 (parallel/distributed.py), bin-mapper sync + per-process row shards
 (parallel/spmd.py), and global-array assembly for the shard_map
-learner (models/gbdt.py).
+learner (models/gbdt.py). The data-parallel learner dispatches jitted
+collectives across processes, which jaxlib's CPU backend refuses
+("Multiprocess computations aren't implemented on the CPU backend") —
+hence the capability gate; the host-transport chaos tests
+(test_distributed_resilience.py) cover the CPU-runnable distributed
+surface.
 """
 
 import os
-import signal
-import socket
 import subprocess
-import sys
 
 import numpy as np
 import pytest
 
 import lightgbm_tpu as lgb
+from _mp_utils import (TESTS_DIR, drain_all, free_port,
+                       requires_multiprocess_computations, spawn_worker,
+                       worker_base_env)
 
-_DIR = os.path.dirname(os.path.abspath(__file__))
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+pytestmark = pytest.mark.mp
 
 
-def _kill_group(proc) -> None:
-    """SIGKILL a worker's whole process group (workers run in their own
-    session); fall back to killing the process alone."""
-    try:
-        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
-    except (ProcessLookupError, PermissionError, OSError):
-        try:
-            proc.kill()
-        except OSError:
-            pass
-
-
-def _drain_all(procs, reason: str):
-    """Kill every worker group and fail with their partial output —
-    a hung collective must not leak orphan workers into the tier-1
-    budget, and the partial logs are the only diagnostic there is."""
-    for q in procs:
-        _kill_group(q)
-    partials = []
-    for rank, q in enumerate(procs):
-        try:
-            out, _ = q.communicate(timeout=30)
-        except Exception:
-            out = b""
-        partials.append(f"--- rank {rank} partial output "
-                        f"(returncode {q.returncode}) ---\n"
-                        f"{out.decode(errors='replace')}")
-    pytest.fail(reason + "; killed worker process groups.\n"
-                + "\n".join(partials))
-
-
+@requires_multiprocess_computations
 @pytest.mark.timeout(600)
 def test_two_process_data_parallel_matches_single_process(tmp_path):
-    port = _free_port()
-    env = {k: v for k, v in os.environ.items()
-           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
-    env["PYTHONPATH"] = os.path.dirname(_DIR)
+    port = free_port()
+    env = worker_base_env()
     procs = [
-        subprocess.Popen(
-            [sys.executable, os.path.join(_DIR, "spmd_worker.py"),
-             str(rank), str(port), str(tmp_path)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            start_new_session=True)
+        spawn_worker([os.path.join(TESTS_DIR, "spmd_worker.py"),
+                      str(rank), str(port), str(tmp_path)], env)
         for rank in (0, 1)
     ]
     outs = []
@@ -82,8 +44,8 @@ def test_two_process_data_parallel_matches_single_process(tmp_path):
         try:
             out, _ = p.communicate(timeout=540)
         except subprocess.TimeoutExpired:
-            _drain_all(procs, "SPMD workers timed out after 540 s "
-                              "(stuck collective?)")
+            drain_all(procs, "SPMD workers timed out after 540 s "
+                             "(stuck collective?)")
         outs.append(out.decode())
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out}"

@@ -167,6 +167,7 @@ _FIXTURES = [
     "tpl004_pos.py", "tpl004_neg.py",
     "tpl005_pos.py", "tpl005_neg.py",
     "obs/tpl006_pos.py", "obs/tpl006_neg.py",
+    "resilience/tpl006_pos.py", "resilience/tpl006_neg.py",
 ]
 
 
