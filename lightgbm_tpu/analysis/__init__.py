@@ -15,8 +15,14 @@ invariants at review time, from the source alone:
   are only ever entered through a ``jax.jit`` / ``pjit`` / ``shard_map``
   wrapper. This replaces the hand-maintained ``KNOWN_JITTED`` allowlist
   the old ``tests/test_hot_path_lint.py`` carried,
+- :mod:`~lightgbm_tpu.analysis.cfg` builds per-function control-flow
+  graphs and solves guard-pin and lock-held dataflow over them;
+  :mod:`~lightgbm_tpu.analysis.dataflow` adds rank taint, the
+  thread-side closure, and float64-producer classification,
 - :mod:`~lightgbm_tpu.analysis.rules` runs the pluggable rule set
-  (TPL001-TPL006, see docs/STATIC_ANALYSIS.md),
+  (statement-level TPL001-TPL006 plus the CFG-based TPL007-TPL009 from
+  :mod:`~lightgbm_tpu.analysis.rules_flow`; see
+  docs/STATIC_ANALYSIS.md),
 - :mod:`~lightgbm_tpu.analysis.baseline` matches findings against the
   checked-in accepted-findings file (tools/tpulint_baseline.txt).
 

@@ -152,6 +152,10 @@ class ModuleScan:
         self.attr_wrappers: Dict[Tuple[str, str], tuple] = {}
         self.hot_lines: Set[int] = set()
         self.disable_lines: Dict[int, Set[str]] = {}
+        # "# tpulint: threadsafe <why>" — line -> justification text.
+        # TPL008 accepts the mark only with a non-empty why (an
+        # acceptance without a reason is just a suppressed race).
+        self.threadsafe_lines: Dict[int, str] = {}
         self._scan_pragmas()
         self._collect(self.tree, [], [], None)
         self._collect_module_imports()
@@ -175,6 +179,11 @@ class ModuleScan:
                     rules = {r.strip() for r in
                              token[len("disable="):].split(",") if r}
                     self.disable_lines.setdefault(i, set()).update(rules)
+                elif token == "threadsafe":
+                    # everything after the marker is the required why
+                    why = body.split("threadsafe", 1)[1].strip()
+                    self.threadsafe_lines[i] = why
+                    break
                 else:
                     break
 

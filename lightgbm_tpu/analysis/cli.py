@@ -10,8 +10,9 @@ pre-commit hooks, docs builds).
 from __future__ import annotations
 
 import argparse
+import os
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
 EXIT_CODES = """\
 exit codes:
@@ -26,17 +27,28 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m lightgbm_tpu lint",
         description=(
             "tpulint: JAX/TPU-aware static analyzer for the boosting "
-            "hot path. Builds a cross-module call graph, computes "
-            "jit-reachability (which functions are only ever entered "
-            "through a jax.jit/pjit/shard_map wrapper), and checks "
-            "the hazard catalog TPL001-TPL006 (eager lax loops, host "
-            "syncs, recompile storms, donation violations, "
-            "order-unstable iteration, locks across dispatch). "
+            "hot path and the distributed layer. Builds a cross-module "
+            "call graph, computes jit-reachability (which functions "
+            "are only ever entered through a jax.jit/pjit/shard_map "
+            "wrapper) plus per-function CFGs with rank-taint and "
+            "lock dataflow, and checks the hazard catalog "
+            "TPL001-TPL009 (eager lax loops, host syncs, recompile "
+            "storms, donation violations, order-unstable iteration, "
+            "locks across dispatch, rank-divergent collective order, "
+            "thread-shared-state races, float64 promotion leaks). "
             "See docs/STATIC_ANALYSIS.md."),
         epilog=EXIT_CODES,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    p.add_argument("--format", choices=("text", "json"), default="text",
-                   help="output format (default: text)")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text",
+                   help="output format (default: text); sarif emits "
+                        "SARIF 2.1.0 for code-review tooling")
+    p.add_argument("--changed", metavar="REF", nargs="?", const="HEAD",
+                   default=None,
+                   help="lint only package files differing from git "
+                        "REF (default HEAD) — the ~100 ms pre-commit "
+                        "mode; with no changed files the analyzer is "
+                        "not even constructed")
     p.add_argument("--baseline", metavar="FILE", default=None,
                    help="accepted-findings file (default: "
                         "tools/tpulint_baseline.txt when present; "
@@ -44,7 +56,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rule", metavar="TPLNNN", action="append",
                    default=None,
                    help="run only this rule (repeatable); default: "
-                        "TPL001-TPL006")
+                        "TPL001-TPL009")
     p.add_argument("--root", metavar="DIR", default=None,
                    help="package directory to analyze (default: the "
                         "installed lightgbm_tpu package)")
@@ -58,6 +70,43 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def changed_relpaths(root: str, ref: str) -> Set[str]:
+    """Package-relative paths of ``*.py`` files differing from git
+    ``ref`` (committed diffs + working tree + untracked). Raises
+    ``ValueError`` when git cannot answer (not a repo, bad ref)."""
+    import subprocess
+    pkg = os.path.basename(os.path.normpath(root))
+    repo = os.path.dirname(os.path.abspath(root))
+    out: Set[str] = set()
+    # --relative: diff paths come out relative to cwd (the package's
+    # parent), not the repo toplevel — required when the package lives
+    # below the repo root, and what ls-files already does
+    cmds = [
+        ["git", "diff", "--relative", "--name-only", ref, "--", pkg],
+        ["git", "ls-files", "--others", "--exclude-standard",
+         "--", pkg],
+    ]
+    for cmd in cmds:
+        try:
+            proc = subprocess.run(cmd, cwd=repo, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.SubprocessError) as e:
+            raise ValueError(f"--changed: {' '.join(cmd[:2])} failed "
+                             f"({e})")
+        if proc.returncode != 0:
+            raise ValueError(
+                f"--changed: `{' '.join(cmd)}` failed: "
+                f"{proc.stderr.strip() or proc.stdout.strip()}")
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.startswith(pkg + "/") and line.endswith(".py"):
+                rel = line[len(pkg) + 1:]
+                # deleted files have nothing left to lint
+                if os.path.exists(os.path.join(root, rel)):
+                    out.add(rel)
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(
         sys.argv[1:] if argv is None else argv)
@@ -68,10 +117,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("tpulint: error: --write-baseline requires a full run "
               "(drop --rule)", file=sys.stderr)
         return 2
-    from .engine import run_lint
+    if args.write_baseline and args.changed is not None:
+        print("tpulint: error: --write-baseline requires a full run "
+              "(drop --changed)", file=sys.stderr)
+        return 2
+    from .engine import default_scope, package_root, run_lint
+    scope = None
+    if args.changed is not None:
+        root = args.root or package_root()
+        try:
+            changed = changed_relpaths(root, args.changed)
+        except ValueError as e:
+            print(f"tpulint: error: {e}", file=sys.stderr)
+            return 2
+        scope = default_scope(sorted(changed))
+        if not scope:
+            # the pre-commit fast path: nothing in the rule scope
+            # changed, so don't even parse the package
+            print(f"tpulint: 0 findings (no files in scope changed "
+                  f"vs {args.changed})")
+            return 0
     try:
         result = run_lint(root=args.root, rules=args.rule,
-                          baseline_path=args.baseline)
+                          baseline_path=args.baseline, scope=scope)
     except (ValueError, OSError, SyntaxError) as e:
         print(f"tpulint: error: {e}", file=sys.stderr)
         return 2
@@ -83,6 +151,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.format == "json":
         from .report import render_json
         print(render_json(result))
+    elif args.format == "sarif":
+        from .report import render_sarif
+        print(render_sarif(result))
     else:
         from .report import render_text
         print(render_text(result))

@@ -93,6 +93,7 @@ def run_lint(root: Optional[str] = None,
     scans = scan_package(root, package=package, files=files)
     graph = CallGraph(scans)
     relpaths = [s.relpath for s in scans]
+    narrowed_scope = scope is not None
     if scope is None:
         scope = default_scope(relpaths) if files is None else \
             set(relpaths)
@@ -132,13 +133,23 @@ def run_lint(root: Optional[str] = None,
     for f in findings:
         seen_fids.add(f.fid)
         (baselined if f.fid in by_fid else kept).append(f)
-    # staleness is only decidable for rules that actually ran: a
-    # --rule-filtered invocation must not report (or --strict-fail on)
-    # other rules' perfectly valid baseline entries
+    # staleness is only decidable for rules that actually ran AND (on
+    # an explicitly narrowed run: --changed, fixture slices) files the
+    # rules reported over — a slice must not report (or --strict-fail
+    # on) baseline entries it could never have re-produced. A FULL run
+    # applies no path filter on purpose: an entry whose file was
+    # deleted or renamed must still surface as stale, or --strict
+    # would let it rot invisibly forever.
     active_ids = {r.id for r in active}
+
+    def _fid_path(fid: str) -> str:
+        parts = fid.split(":", 2)
+        return parts[1] if len(parts) >= 2 else ""
+
     stale = [e for e in entries
              if e.fid not in seen_fids
-             and e.fid.split(":", 1)[0] in active_ids]
+             and e.fid.split(":", 1)[0] in active_ids
+             and (not narrowed_scope or _fid_path(e.fid) in scope)]
     unjustified = [e for e in entries if not e.justification]
     kept.sort(key=lambda f: f.sort_key())
     baselined.sort(key=lambda f: f.sort_key())

@@ -1,11 +1,22 @@
-"""Finding renderers: human text and machine JSON."""
+"""Finding renderers: human text, machine JSON, and SARIF 2.1.0.
+
+SARIF (``lint --format sarif``) is the exchange format code-review
+tooling ingests (GitHub code scanning, VS Code SARIF viewers): one
+``run`` with the tpulint driver + rule catalog, one ``result`` per
+non-baselined finding, with the stable line-number-free finding id in
+``partialFingerprints`` so review systems track findings across code
+motion exactly like the baseline does.
+"""
 
 from __future__ import annotations
 
 import json
 from typing import List
 
-__all__ = ["render_text", "render_json"]
+__all__ = ["render_text", "render_json", "render_sarif"]
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
 
 
 def render_text(result) -> str:
@@ -47,3 +58,65 @@ def render_json(result) -> str:
             f"{p}:{q}" for (p, q) in result.graph.jit_reachable),
         "elapsed_seconds": result.elapsed,
     }, indent=2, sort_keys=False)
+
+
+def render_sarif(result) -> str:
+    """SARIF 2.1.0 — attachable to code-review tooling. Non-baselined
+    findings become ``results``; baselined ones ride along with a
+    ``suppressions`` entry so reviewers see the accepted set too."""
+    from .rules import ALL_RULES
+
+    pkg = ""
+    for s in result.graph.scans.values():
+        pkg = s.module.split(".", 1)[0]
+        break
+
+    def _result(f, suppressed: bool):
+        out = {
+            "ruleId": f.rule,
+            "level": "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f"{pkg}/{f.relpath}" if pkg
+                               else f.relpath,
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {"startLine": f.lineno,
+                               "startColumn": f.col + 1},
+                },
+                "logicalLocations": [{
+                    "name": f.func,
+                    "kind": "function",
+                }],
+            }],
+            "partialFingerprints": {"tpulintFindingId/v1": f.fid},
+        }
+        if suppressed:
+            out["suppressions"] = [{
+                "kind": "external",
+                "justification": "accepted in tools/"
+                                 "tpulint_baseline.txt",
+            }]
+        return out
+
+    payload = {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpulint",
+                "informationUri":
+                    "docs/STATIC_ANALYSIS.md",
+                "rules": [{
+                    "id": r.id,
+                    "shortDescription": {"text": r.title},
+                    "helpUri": "docs/STATIC_ANALYSIS.md",
+                } for r in ALL_RULES],
+            }},
+            "results": [_result(f, False) for f in result.findings]
+            + [_result(f, True) for f in result.baselined],
+        }],
+    }
+    return json.dumps(payload, indent=2)

@@ -1,11 +1,14 @@
-"""The tpulint rule set (TPL001-TPL006). Pure stdlib.
+"""The tpulint rule set (TPL001-TPL009). Pure stdlib.
 
 Each rule is a class with a stable ``id``, a one-line ``title``, and a
 ``run(ctx)`` generator yielding :class:`Finding`. Rules see the whole
 :class:`~lightgbm_tpu.analysis.callgraph.CallGraph` (jit-reachability,
 call records, hot markers) plus the raw ASTs, and are scoped to the
-hot-path files by the engine. docs/STATIC_ANALYSIS.md documents each
-rule's hazard, an example, the fix, and how to baseline.
+hot-path files by the engine. The statement-level rules TPL001-TPL006
+live here; the CFG/dataflow rules TPL007-TPL009 live in
+:mod:`~lightgbm_tpu.analysis.rules_flow` and are re-registered into
+``ALL_RULES`` below. docs/STATIC_ANALYSIS.md documents each rule's
+hazard, an example, the fix, and how to baseline.
 """
 
 from __future__ import annotations
@@ -536,9 +539,14 @@ class LockAcrossDispatch(Rule):
                     break
 
 
+#: imported at the bottom on purpose: rules_flow subclasses Rule/uses
+#: Finding, so it needs this module's upper half to exist first. Import
+#: THIS module (or the package) for the full rule set.
+from .rules_flow import FLOW_RULES  # noqa: E402
+
 ALL_RULES: List[Rule] = [EagerLaxLoop(), HostSync(), RecompileHazard(),
                          DonationViolation(), UnorderedIteration(),
-                         LockAcrossDispatch()]
+                         LockAcrossDispatch(), *FLOW_RULES]
 
 
 def rule_by_id(rid: str) -> Optional[Rule]:

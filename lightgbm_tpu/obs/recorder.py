@@ -199,21 +199,23 @@ class TelemetryRecorder:
         models/gbdt.py ``fault_log``) into the JSONL stream, plus the
         process-level log (``resilience.faults.FAULT_EVENTS``: init
         retries, watchdog timeouts, distributed injections). All were
-        already counted in the metrics registry at record time."""
+        already counted in the metrics registry at record time. Both
+        logs are swapped out through ``faults.drain_events`` — the
+        locked snapshot-and-clear — because appends can land from
+        another thread (a watchdog abort, a second trainer) between a
+        bare copy and clear, and that event would be lost forever."""
+        try:
+            from ..resilience.faults import FAULT_EVENTS, drain_events
+        except Exception:
+            return
         for eng in self._engines:
             log = getattr(eng, "fault_log", None)
             if not log:
                 continue
-            events, log[:] = list(log), []
-            for ev in events:
+            for ev in drain_events(log):
                 self._write_line(ev)
-        try:
-            from ..resilience.faults import FAULT_EVENTS
-        except Exception:
-            return
         if FAULT_EVENTS:
-            events, FAULT_EVENTS[:] = list(FAULT_EVENTS), []
-            for ev in events:
+            for ev in drain_events(FAULT_EVENTS):
                 self._write_line(ev)
 
     def record_iteration(self, iteration: int,

@@ -36,6 +36,7 @@ from __future__ import annotations
 import io
 import itertools
 import os
+import threading
 import time
 from typing import List, Optional
 
@@ -60,8 +61,13 @@ _KV_CLEANUP_BYTES = 1 << 16
 #: delete once a LATER gather completes: completing gather epoch E
 #: required reading every rank's epoch-E key, hence every rank had
 #: already finished every epoch < E (and with it, every read of our
-#: older keys).
+#: older keys). Every ``_kv_exchange`` runs on a FRESH watchdog worker
+#: thread (and concurrent trainers on separate host threads share this
+#: module), so mutations go through ``_pending_lock`` — copy under the
+#: lock, talk to the kv store outside it (tpulint TPL008 proves this
+#: on the lock-acquisition CFG).
 _pending_delete: List[str] = []
+_pending_lock = threading.Lock()
 
 
 def _kv_client():
@@ -173,17 +179,22 @@ def _kv_exchange(name: str, payload: Optional[bytes],
         if payload is not None:
             client.key_value_delete(f"{prefix}/{me}")
     elif payload is not None:
+        doomed: List[str] = []
         if gather:
             # completing a gather proves every rank finished all
             # earlier epochs, so our previously published keys are
-            # dead — flush them, then queue this one
-            for key in _pending_delete:
-                try:
-                    client.key_value_delete(key)
-                except Exception:
-                    pass
-            _pending_delete.clear()
-        _pending_delete.append(f"{prefix}/{me}")
+            # dead — snapshot-and-clear under the lock, delete outside
+            # it (kv deletes are gRPC round trips; never hold the lock
+            # across them)
+            with _pending_lock:
+                doomed, _pending_delete[:] = list(_pending_delete), []
+        for key in doomed:
+            try:
+                client.key_value_delete(key)
+            except Exception:
+                pass
+        with _pending_lock:
+            _pending_delete.append(f"{prefix}/{me}")
     return out
 
 

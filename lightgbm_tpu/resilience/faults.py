@@ -47,12 +47,13 @@ from __future__ import annotations
 
 import os
 import signal
+import threading
 import time
 from typing import Dict, List, Tuple
 
 __all__ = ["FaultPlan", "InjectedResourceExhausted", "InjectedInitRefused",
            "is_resource_exhausted", "append_fault_event",
-           "record_fault_event", "FAULT_EVENTS"]
+           "record_fault_event", "drain_events", "FAULT_EVENTS"]
 
 _KNOWN_KINDS = ("nan_grad", "nan_hess", "oom", "kill",
                 "rank_kill", "stall_rank", "init_refuse")
@@ -64,6 +65,14 @@ _KNOWN_KINDS = ("nan_grad", "nan_hess", "oom", "kill",
 #: cannot grow it forever.
 FAULT_EVENTS: List[dict] = []
 
+#: one process-wide lock for every fault-event log (the global one AND
+#: the per-engine ``fault_log``s): appends can come from one thread
+#: (a watchdog abort path, a second trainer) while the telemetry
+#: recorder drains on another — an unlocked snapshot-then-clear would
+#: silently drop every event that landed in between. Critical sections
+#: are a list append / a list swap, so one shared lock is cheap.
+_EVENTS_LOCK = threading.Lock()
+
 
 def append_fault_event(log: List[dict], kind: str, iteration: int,
                        action: str, detail: str) -> None:
@@ -73,12 +82,14 @@ def append_fault_event(log: List[dict], kind: str, iteration: int,
     registry counter, and warn. Both the engine's per-booster
     ``fault_log`` (``GBDTBooster._record_fault``) and the process-level
     :data:`FAULT_EVENTS` go through here, so the recorder drains one
-    schema."""
-    if len(log) >= 512:
-        del log[0]
-    log.append({
-        "event": "fault", "kind": kind, "iteration": int(iteration),
-        "action": action, "detail": detail, "time": time.time()})
+    schema — and one lock orders appends against
+    :func:`drain_events`."""
+    with _EVENTS_LOCK:
+        if len(log) >= 512:
+            del log[0]
+        log.append({
+            "event": "fault", "kind": kind, "iteration": int(iteration),
+            "action": action, "detail": detail, "time": time.time()})
     try:
         from ..obs.registry import registry
         registry.counter("fault_events", kind=kind).inc()
@@ -94,6 +105,18 @@ def record_fault_event(kind: str, iteration: int = -1, action: str = "",
     """Process-level fault event (no engine in scope): goes to
     :data:`FAULT_EVENTS`."""
     append_fault_event(FAULT_EVENTS, kind, iteration, action, detail)
+
+
+def drain_events(log: List[dict]) -> List[dict]:
+    """Atomically snapshot-and-clear a fault-event log (the global
+    :data:`FAULT_EVENTS` or an engine ``fault_log``). The swap happens
+    under the same lock :func:`append_fault_event` takes, so an event
+    appended concurrently lands either in this drain or in the next —
+    never in neither (the lost-event race the telemetry recorder had
+    with its bare ``list(log), []`` swap)."""
+    with _EVENTS_LOCK:
+        events, log[:] = list(log), []
+    return events
 
 
 class InjectedResourceExhausted(RuntimeError):

@@ -145,6 +145,11 @@ def guarded(name: str, fn: Callable, *args,
     box: Dict[str, Any] = {}
     done = threading.Event()
 
+    # box is written only before done.set() and read only after
+    # done.wait() returned True, so the Event establishes the
+    # happens-before; on a timeout the abandoned worker's late write is
+    # never read (box is per-call and unreachable after the raise).
+    # tpulint: threadsafe Event handshake (write, set, wait, read)
     def _run() -> None:
         try:
             box["value"] = fn(*args)

@@ -1,0 +1,50 @@
+# tpulint fixture: TPL008 negative — the same recorder-with-drain-
+# thread as obs/tpl008_pos.py, with every thread-shared field guarded
+# by a lock COMMON to both sides (proved on the lock-acquisition CFG,
+# including an acquire()/release() pair). No EXPECT lines.
+import threading
+
+_events = []
+_events_lock = threading.Lock()
+
+
+class Recorder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []
+        self._drainer = threading.Thread(target=self._drain,
+                                         daemon=True)
+        self._drainer.start()
+
+    def _drain(self):
+        while True:
+            with self._lock:
+                self.pending.clear()
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.pending)
+
+
+def _worker():
+    _events_lock.acquire()
+    _events.append({"event": "fault"})
+    _events_lock.release()
+
+
+def start_worker():
+    threading.Thread(target=_worker).start()
+    with _events_lock:
+        return list(_events)
+
+
+def _queue_worker(q):
+    # synchronization primitives are exempt: a Queue orders handoffs
+    q.put({"event": "fault"})
+
+
+def start_queue_worker():
+    import queue
+    q = queue.Queue()
+    threading.Thread(target=_queue_worker, args=(q,)).start()
+    return q.get()

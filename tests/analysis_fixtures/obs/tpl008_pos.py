@@ -1,0 +1,42 @@
+# tpulint fixture: TPL008 positive — a telemetry recorder whose drain
+# thread mutates fields no lock guards. This is exactly the
+# "delete the lock around a thread-shared field" acceptance shape:
+# obs/tpl008_neg.py is the same recorder WITH the locks, and removing
+# them must re-surface these findings.
+import threading
+
+_events = []          # module-global fault queue
+
+
+class Recorder:
+    def __init__(self):
+        self.pending = []
+        self._drainer = threading.Thread(target=self._drain,
+                                         daemon=True)
+        self._drainer.start()
+
+    def _drain(self):
+        while True:
+            # EXPECT: TPL008
+            self.pending.clear()
+
+    def snapshot(self):
+        return list(self.pending)
+
+
+def _worker():
+    # EXPECT: TPL008
+    _events.append({"event": "fault"})
+
+
+# tpulint: threadsafe
+def _pragma_without_reason_is_not_a_justification():
+    # EXPECT: TPL008
+    _events.append({"event": "fault"})
+
+
+def start_workers():
+    threading.Thread(target=_worker).start()
+    threading.Thread(
+        target=_pragma_without_reason_is_not_a_justification).start()
+    return list(_events)

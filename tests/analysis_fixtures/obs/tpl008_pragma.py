@@ -1,0 +1,21 @@
+# tpulint fixture: TPL008 pragma suppression — an Event handshake
+# already orders the shared write, and the `# tpulint: threadsafe`
+# mark carries the REQUIRED why (a bare mark does not suppress: see
+# obs/tpl008_pos.py). Negative fixture: no EXPECT lines.
+import threading
+
+_box = {}
+
+
+# tpulint: threadsafe Event handshake — _box is written before
+def _worker(done):
+    _box["value"] = 42
+    done.set()
+
+
+def run():
+    done = threading.Event()
+    worker = threading.Thread(target=_worker, args=(done,))
+    worker.start()
+    done.wait()
+    return _box["value"]

@@ -1,0 +1,49 @@
+# tpulint fixture: TPL007 negative — rank-dependent ARGUMENTS and
+# uniform gates are fine; the CFG meet must keep fall-through branches
+# pin-free. No EXPECT lines: the engine must report nothing here.
+import json
+
+import jax
+
+from lightgbm_tpu.parallel.hostsync import (host_allgather,
+                                            host_broadcast_bytes)
+
+
+def rank_dependent_argument(mappers):
+    """The sync_bin_mappers pattern: rank 0 builds the payload under a
+    rank branch, then EVERY rank joins the broadcast."""
+    payload = None
+    if jax.process_index() == 0:
+        payload = json.dumps(mappers).encode()
+    return host_broadcast_bytes(payload, "ok/broadcast")
+
+
+def world_size_gate(arr):
+    """process_count() is rank-invariant — gating on it is uniform."""
+    if jax.process_count() <= 1:
+        return arr[None]
+    return host_allgather(arr, "ok/world_gate")
+
+
+def uniform_early_return(arr, enabled):
+    if not enabled:
+        return None
+    return host_allgather(arr, "ok/uniform_flag")
+
+
+def rank_gated_local_side_effect(arr, path):
+    """Rank-gating NON-collective work after the sync is the idiom
+    (rank-0-only checkpoint writes)."""
+    g = host_allgather(arr, "ok/gather")
+    if jax.process_index() == 0:
+        with open(path, "wb") as fh:
+            fh.write(bytes(g))
+    return g
+
+
+def collective_in_try_body(arr):
+    """The try BODY runs on every rank; only handlers diverge."""
+    try:
+        return host_allgather(arr, "ok/try_body")
+    except RuntimeError:
+        return None

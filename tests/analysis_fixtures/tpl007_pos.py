@@ -1,0 +1,55 @@
+# tpulint fixture: TPL007 positive — rank-divergent collective order.
+# An `# EXPECT: <RULE>` comment pins a finding (by rule id + line
+# number) on the line that FOLLOWS it. Fixtures are parsed, never
+# imported.
+import os
+
+import jax
+from jax.experimental import multihost_utils
+
+from lightgbm_tpu.parallel.hostsync import (host_allgather,
+                                            host_broadcast_bytes)
+
+
+def rank_gated_collective(arr):
+    """The direct shape: only rank 0 ever joins the allgather."""
+    if jax.process_index() == 0:
+        # EXPECT: TPL007
+        return host_allgather(arr, "bad/rank_gated")
+    return arr[None]
+
+
+def early_return_divergence(arr):
+    """The early-return shape: the collective is lexically unguarded,
+    but the CFG meet carries the rank pin past the diverting arm."""
+    rank = jax.process_index()
+    if rank != 0:
+        return None
+    # EXPECT: TPL007
+    return host_allgather(arr, "bad/early_return")
+
+
+def collective_in_handler(arr):
+    """Only ranks that hit the exception run the recovery broadcast."""
+    try:
+        out = host_allgather(arr, "ok/try_body_is_fine")
+    except RuntimeError:
+        # EXPECT: TPL007
+        host_broadcast_bytes(b"", "bad/recovery")
+        out = None
+    return out
+
+
+def env_rank_gate():
+    """LIGHTGBM_TPU_RANK-derived condition, through int()."""
+    me = int(os.environ.get("LIGHTGBM_TPU_RANK", "0"))
+    if me == 0:
+        # EXPECT: TPL007
+        multihost_utils.sync_global_devices("bad/env_gate")
+
+
+def rank_dependent_trip_count(arr):
+    """A rank-dependent number of joins deadlocks like a skipped one."""
+    for _ in range(jax.process_index()):
+        # EXPECT: TPL007
+        host_allgather(arr, "bad/loop")

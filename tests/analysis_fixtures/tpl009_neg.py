@@ -1,0 +1,33 @@
+# tpulint fixture: TPL009 negative — float32 tables at the jit
+# boundary, and host-only float64 that never enters traced code. No
+# EXPECT lines.
+import jax
+import numpy as np
+
+
+@jax.jit
+def traced(x):
+    return x * 2.0
+
+
+def f32_table(n):
+    return traced(np.zeros((n,), np.float32))
+
+
+def explicit_f32_asarray(values):
+    return traced(np.asarray(values, dtype=np.float32))
+
+
+def rebound_to_f32_before_the_call(n):
+    table = np.zeros((n,))             # f64, but...
+    table = table.astype(np.float32)   # ...rebound before use
+    return traced(table)
+
+
+def host_only_f64(n):
+    stats = np.zeros((n,))             # f64 stays on the host
+    return stats.sum()
+
+
+def int_arange(n):
+    return traced(np.arange(n))        # int64, not float

@@ -1,0 +1,32 @@
+# tpulint fixture: TPL009 positive — float64-producing numpy values
+# flowing into jit-reachable functions (silent per-call downcast under
+# x64-off; full-program f64 promotion under x64-on).
+import jax
+import numpy as np
+
+
+@jax.jit
+def traced(x):
+    return x * 2.0
+
+
+def f64_by_default_ctor(n):
+    # np.zeros with no dtype is float64
+    # EXPECT: TPL009
+    return traced(np.zeros((n,)))
+
+
+def f64_explicit_dtype(values):
+    # EXPECT: TPL009
+    return traced(np.asarray(values, np.float64))
+
+
+def f64_through_a_local(n):
+    thresholds = np.linspace(0.0, 1.0, n)
+    # EXPECT: TPL009
+    return traced(thresholds)
+
+
+def f64_astype(x):
+    # EXPECT: TPL009
+    return traced(x.astype("float64"))

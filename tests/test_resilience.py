@@ -451,3 +451,122 @@ def test_sigkill_mid_train_resumes_byte_identical(tmp_path):
 
     with open(killed_model) as a, open(clean_model) as b:
         assert a.read() == b.read()
+
+
+# ---------------------------------------------------------------------
+# hostsync kv bookkeeping: the _pending_delete lock (ISSUE 5 / TPL008)
+# ---------------------------------------------------------------------
+
+class _FakeKvClient:
+    """In-memory stand-in for the coordination-service client: enough
+    surface for _kv_exchange, with a thread-safe ledger of published
+    and deleted keys so the pending-delete bookkeeping is auditable."""
+
+    def __init__(self):
+        import threading
+        self._lock = threading.Lock()
+        self.store = {}
+        self.published = []
+        self.deleted = []
+
+    def key_value_set_bytes(self, key, value):
+        with self._lock:
+            self.store[key] = value
+            self.published.append(key)
+
+    def blocking_key_value_get_bytes(self, key, timeout_ms):
+        with self._lock:
+            if key in self.store:
+                return self.store[key]
+        raise RuntimeError(f"DEADLINE_EXCEEDED: {key}")
+
+    def key_value_delete(self, key):
+        with self._lock:
+            self.store.pop(key, None)
+            self.deleted.append(key)
+
+    def wait_at_barrier(self, key, timeout_ms):
+        return None
+
+
+def _single_rank_kv(monkeypatch):
+    """Wire _kv_exchange to a fake client in a 1-process world (every
+    read is our own key, so no blocking)."""
+    import jax
+
+    from lightgbm_tpu.parallel import hostsync
+
+    client = _FakeKvClient()
+    monkeypatch.setattr(hostsync, "_kv_client", lambda: client)
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    monkeypatch.setattr(jax, "process_count", lambda: 1)
+    # drain state other tests may have left
+    with hostsync._pending_lock:
+        hostsync._pending_delete[:] = []
+    return client, hostsync
+
+
+def test_kv_pending_delete_flushed_on_next_gather(monkeypatch):
+    """Small keys are deleted lazily: epoch E's key is flushed when a
+    LATER gather completes (the epoch argument proves every rank is
+    past E). The copy-under-lock refactor must preserve exactly that
+    protocol."""
+    client, hostsync = _single_rank_kv(monkeypatch)
+    hostsync._kv_exchange("unit/a", b"x", gather=True)
+    with hostsync._pending_lock:
+        assert len(hostsync._pending_delete) == 1
+    first_key = hostsync._pending_delete[0]
+    assert client.deleted == []
+
+    hostsync._kv_exchange("unit/b", b"y", gather=True)
+    assert client.deleted == [first_key]
+    with hostsync._pending_lock:
+        assert len(hostsync._pending_delete) == 1
+        assert hostsync._pending_delete[0] != first_key
+
+
+def test_kv_large_payloads_barrier_and_delete_eagerly(monkeypatch):
+    client, hostsync = _single_rank_kv(monkeypatch)
+    big = b"z" * (hostsync._KV_CLEANUP_BYTES + 1)
+    hostsync._kv_exchange("unit/big", big, gather=True)
+    assert client.deleted == client.published  # deleted after barrier
+    with hostsync._pending_lock:
+        assert hostsync._pending_delete == []
+
+
+def test_kv_pending_delete_no_key_lost_across_threads(monkeypatch):
+    """The TPL008 race made concrete: concurrent exchanges (two
+    trainers, successive watchdog workers) must neither lose a pending
+    key (a coordinator store leak) nor double-delete one. With the
+    lock, every published small key is deleted exactly once or still
+    queued at the end."""
+    import threading
+
+    client, hostsync = _single_rank_kv(monkeypatch)
+    n_threads, per_thread = 6, 40
+    start = threading.Barrier(n_threads)
+    errors = []
+
+    def worker(tid):
+        start.wait()
+        try:
+            for i in range(per_thread):
+                hostsync._kv_exchange(f"unit/t{tid}/{i}", b"k",
+                                      gather=True)
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    with hostsync._pending_lock:
+        remaining = list(hostsync._pending_delete)
+    deleted = list(client.deleted)
+    assert len(deleted) == len(set(deleted)), "a key was deleted twice"
+    assert sorted(deleted + remaining) == sorted(set(client.published)), (
+        "pending-delete bookkeeping lost or duplicated keys under "
+        "concurrency")

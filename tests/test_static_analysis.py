@@ -168,6 +168,10 @@ _FIXTURES = [
     "tpl005_pos.py", "tpl005_neg.py",
     "obs/tpl006_pos.py", "obs/tpl006_neg.py",
     "resilience/tpl006_pos.py", "resilience/tpl006_neg.py",
+    "tpl007_pos.py", "tpl007_neg.py",
+    "obs/tpl008_pos.py", "obs/tpl008_neg.py",
+    "obs/tpl008_pragma.py",
+    "tpl009_pos.py", "tpl009_neg.py",
 ]
 
 
@@ -336,3 +340,365 @@ def test_nonfinite_guard_stays_inside_jitted_step():
     assert "GBDTBooster._train_one_iter_fused" in hot, (
         "_train_one_iter_fused lost its '# tpulint: hot' marker — "
         "TPL002 no longer guards the fused driver")
+
+
+# ---------------------------------------------------------------------
+# 5. CFG rules (TPL007-TPL009) against the REAL distributed layer:
+#    the shipped tree is clean, and the exact mutations the acceptance
+#    criteria name re-surface the expected finding ids
+# ---------------------------------------------------------------------
+
+def _lint_mutated(relpath, transform, rules, tmp_path):
+    """Apply a source-text ``transform`` to one real package file and
+    lint the mutated copy in isolation."""
+    with open(os.path.join(PKG, relpath), encoding="utf-8") as fh:
+        src = fh.read()
+    mutated = transform(src)
+    assert mutated != src, f"mutation did not apply to {relpath}"
+    dst = tmp_path / relpath
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_text(mutated, encoding="utf-8")
+    return run_lint(root=str(tmp_path), package="lightgbm_tpu",
+                    files=[relpath], baseline_path="",
+                    rules=list(rules))
+
+
+def test_distributed_layer_is_collective_order_clean():
+    res = _cached_lint(("TPL007",))
+    assert not res.findings, (
+        "rank-divergent collective order in the shipped tree:\n  "
+        + "\n  ".join(f"{f.fid} @ {f.relpath}:{f.lineno}"
+                      for f in res.findings))
+
+
+def test_reordering_a_collective_behind_a_rank_guard_fails(tmp_path):
+    """The acceptance mutation: gate spmd.verify_step_consistency's
+    allgather behind a process_index() early return -> TPL007 with the
+    expected stable id."""
+    anchor = ("    local = np.asarray([int(iteration), "
+              "int(num_trees)], np.int64)")
+    res = _lint_mutated(
+        "parallel/spmd.py",
+        lambda src: src.replace(
+            anchor,
+            "    if jax.process_index() != 0:\n        return\n"
+            + anchor),
+        ["TPL007"], tmp_path)
+    fids = [f.fid for f in res.findings]
+    assert ("TPL007:parallel/spmd.py:verify_step_consistency:"
+            "collective:host_allgather#1") in fids, fids
+
+
+def test_collective_in_except_handler_fails(tmp_path):
+    """Wrapping sync_bin_mappers' broadcast into an error-recovery
+    handler -> TPL007 (only some ranks run recovery paths)."""
+    anchor = '    buf = host_broadcast_bytes(payload, "spmd/sync_bin_mappers")'
+    replacement = (
+        "    try:\n"
+        "        raise RuntimeError()\n"
+        "    except RuntimeError:\n"
+        "        buf = host_broadcast_bytes(payload, "
+        '"spmd/sync_bin_mappers")')
+    res = _lint_mutated(
+        "parallel/spmd.py",
+        lambda src: src.replace(anchor, replacement),
+        ["TPL007"], tmp_path)
+    assert any(f.rule == "TPL007"
+               and f.symbol == "collective:host_broadcast_bytes"
+               and f.func == "sync_bin_mappers"
+               for f in res.findings), [f.fid for f in res.findings]
+
+
+def test_deleting_the_pending_delete_lock_fails(tmp_path):
+    """The acceptance mutation: strip the _pending_lock guards from
+    hostsync's kv bookkeeping -> TPL008 names the shared list (it is
+    mutated from the watchdog's worker threads)."""
+    def strip_locks(src):
+        src = src.replace(
+            "            with _pending_lock:\n"
+            "                doomed, _pending_delete[:] = "
+            "list(_pending_delete), []",
+            "            doomed, _pending_delete[:] = "
+            "list(_pending_delete), []")
+        src = src.replace(
+            "        with _pending_lock:\n"
+            "            _pending_delete.append(f\"{prefix}/{me}\")",
+            "        _pending_delete.append(f\"{prefix}/{me}\")")
+        return src
+
+    res = _lint_mutated("parallel/hostsync.py", strip_locks,
+                        ["TPL008"], tmp_path)
+    fids = [f.fid for f in res.findings]
+    assert ("TPL008:parallel/hostsync.py:_kv_exchange:"
+            "shared:_pending_delete#1") in fids, fids
+
+
+def test_stripping_the_watchdog_threadsafe_pragma_fails(tmp_path):
+    """watchdog.guarded's box handshake is Event-ordered and carries
+    the pragma saying why; without the pragma TPL008 must flag both
+    worker-side writes."""
+    pragma = ("    # tpulint: threadsafe Event handshake "
+              "(write, set, wait, read)\n")
+    res = _lint_mutated(
+        "resilience/watchdog.py",
+        lambda src: src.replace(pragma, ""),
+        ["TPL008"], tmp_path)
+    fids = [f.fid for f in res.findings]
+    assert ("TPL008:resilience/watchdog.py:guarded._run:"
+            "shared:box#1") in fids, fids
+    assert ("TPL008:resilience/watchdog.py:guarded._run:"
+            "shared:box#2") in fids, fids
+
+
+def test_threadsafe_pragma_requires_a_reason():
+    """`# tpulint: threadsafe` with no why must NOT suppress (the
+    obs/tpl008_pos.py fixture carries exactly that case); with a why it
+    must (obs/tpl008_pragma.py)."""
+    res = run_lint(root=FIXTURES, package="tpulint_fixtures",
+                   files=["obs/tpl008_pos.py"], baseline_path="")
+    bare = [f for f in res.findings
+            if "_pragma_without_reason" in f.func]
+    assert bare, "bare threadsafe pragma suppressed a finding"
+    res2 = run_lint(root=FIXTURES, package="tpulint_fixtures",
+                    files=["obs/tpl008_pragma.py"], baseline_path="")
+    assert not res2.findings
+
+
+# ---------------------------------------------------------------------
+# 6. CI wiring, --changed mode, SARIF
+# ---------------------------------------------------------------------
+
+def test_lint_sh_strict_is_clean_and_fast():
+    """tools/lint.sh (the CI one-shot) must pass --strict with
+    TPL007-TPL009 enabled, within the 10 s review-time budget."""
+    import time as _time
+    t0 = _time.perf_counter()
+    proc = subprocess.run(
+        ["sh", os.path.join(REPO, "tools", "lint.sh")], cwd=REPO,
+        capture_output=True, text=True, timeout=120)
+    elapsed = _time.perf_counter() - t0
+    assert proc.returncode == 0, (
+        f"tools/lint.sh --strict failed (rc={proc.returncode}):\n"
+        f"{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}")
+    assert elapsed < 10.0, f"lint.sh took {elapsed:.1f}s (budget 10s)"
+    from lightgbm_tpu.analysis import ALL_RULES
+    assert {"TPL007", "TPL008", "TPL009"} <= {r.id for r in ALL_RULES}
+
+
+def _git(cwd, *args):
+    proc = subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=cwd, capture_output=True, text=True, timeout=30)
+    assert proc.returncode == 0, proc.stderr
+    return proc.stdout
+
+
+def _throwaway_repo(tmp_path):
+    """A git repo holding a tiny lightgbm_tpu package with one
+    committed in-scope module."""
+    repo = tmp_path / "repo"
+    pkg = repo / "lightgbm_tpu"
+    (pkg / "models").mkdir(parents=True)
+    (pkg / "models" / "clean.py").write_text("X = 1\n")
+    (pkg / "utils.py").write_text("Y = 1\n")
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    return repo, pkg
+
+
+def test_changed_mode_fast_path_and_findings(tmp_path):
+    from lightgbm_tpu.analysis.cli import changed_relpaths, main
+
+    repo, pkg = _throwaway_repo(tmp_path)
+    # nothing changed: the fast path answers without building the
+    # analyzer at all
+    assert changed_relpaths(str(pkg), "HEAD") == set()
+    assert main(["--changed", "--root", str(pkg)]) == 0
+
+    # an out-of-scope change still takes the fast path
+    (pkg / "utils.py").write_text("Y = 2\n")
+    assert changed_relpaths(str(pkg), "HEAD") == {"utils.py"}
+    assert main(["--changed", "--root", str(pkg)]) == 0
+
+    # an in-scope change with a fresh TPL001 makes --changed fail
+    (pkg / "models" / "clean.py").write_text(
+        "from jax import lax\n\n\n"
+        "def eager(xs):\n"
+        "    def body(i, acc):\n"
+        "        return acc + xs[i]\n"
+        "    return lax.fori_loop(0, 3, body, 0.0)\n")
+    assert changed_relpaths(str(pkg), "HEAD") == \
+        {"models/clean.py", "utils.py"}
+    assert main(["--changed", "--root", str(pkg),
+                 "--baseline", ""]) == 1
+
+    # untracked new files count as changed too
+    (pkg / "models" / "new.py").write_text("Z = 1\n")
+    assert "models/new.py" in changed_relpaths(str(pkg), "HEAD")
+
+
+def test_changed_mode_does_not_report_out_of_scope_stale_entries():
+    """--changed restricted to files without baseline entries must not
+    call the models/gbdt.py acceptances stale (staleness is only
+    decidable where rules ran)."""
+    res = run_lint(root=PKG, scope={"parallel/hostsync.py"},
+                   baseline_path=BASELINE)
+    assert not res.findings
+    assert not res.stale_baseline, [e.fid for e in res.stale_baseline]
+
+
+def test_sarif_output_schema_shape():
+    from lightgbm_tpu.analysis.report import render_sarif
+
+    res = run_lint(root=FIXTURES, package="tpulint_fixtures",
+                   files=["tpl001_pos.py"], baseline_path="")
+    payload = json.loads(render_sarif(res))
+    assert payload["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in payload["$schema"]
+    run = payload["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "tpulint"
+    rule_ids = {r["id"] for r in driver["rules"]}
+    assert {"TPL001", "TPL007", "TPL008", "TPL009"} <= rule_ids
+    assert run["results"], "a positive fixture must produce results"
+    r0 = run["results"][0]
+    assert r0["ruleId"] == "TPL001"
+    assert r0["level"] == "warning"
+    assert r0["message"]["text"]
+    loc = r0["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == \
+        "tpulint_fixtures/tpl001_pos.py"
+    assert loc["region"]["startLine"] > 0
+    assert loc["region"]["startColumn"] > 0
+    assert r0["partialFingerprints"]["tpulintFindingId/v1"].startswith(
+        "TPL001:")
+
+
+def test_sarif_cli_and_baselined_suppressions():
+    """`lint --format sarif` on the real package: exit 0, valid JSON,
+    and the baselined findings ride along as suppressed results."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "lint",
+         "--format", "sarif"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    payload = json.loads(proc.stdout)
+    results = payload["runs"][0]["results"]
+    suppressed = [r for r in results if r.get("suppressions")]
+    assert len(suppressed) == len(results), \
+        "a clean tree must only carry baselined (suppressed) results"
+    assert suppressed, "the 3 baseline acceptances should be present"
+
+
+# ---------------------------------------------------------------------
+# 7. CFG/dataflow precision regressions (review findings)
+# ---------------------------------------------------------------------
+
+def _cfg_of(src, fn_name):
+    from lightgbm_tpu.analysis.cfg import FunctionCFG
+    tree = ast.parse(src)
+    fn = next(n for n in ast.walk(tree)
+              if isinstance(n, ast.FunctionDef) and n.name == fn_name)
+    return FunctionCFG(fn), fn
+
+
+def test_cfg_branch_local_acquire_does_not_leak_past_the_branch():
+    """An acquire() inside ONE arm of a branch must not count as held
+    on the join (the meet over both paths), and never on the other
+    arm — the lock transfer walks compound-statement headers only."""
+    cfg, fn = _cfg_of(
+        "def f(cond):\n"
+        "    if cond:\n"
+        "        _lock.acquire()\n"
+        "        a = 1\n"
+        "    else:\n"
+        "        b = 1\n"
+        "    shared.append(1)\n",
+        "f")
+    nodes = {n.targets[0].id if isinstance(n, ast.Assign) else "append":
+             n for n in ast.walk(fn)
+             if isinstance(n, ast.Assign)
+             or (isinstance(n, ast.Call)
+                 and isinstance(n.func, ast.Attribute)
+                 and n.func.attr == "append")}
+    assert "_lock" in cfg.held_locks(nodes["a"])     # after acquire
+    assert not cfg.held_locks(nodes["b"])            # other arm
+    assert not cfg.held_locks(nodes["append"])       # join: meet = {}
+
+
+def test_cfg_release_in_branch_does_not_unlock_the_other_path():
+    cfg, fn = _cfg_of(
+        "def f(cond):\n"
+        "    _lock.acquire()\n"
+        "    if cond:\n"
+        "        _lock.release()\n"
+        "        return\n"
+        "    shared.append(1)\n",
+        "f")
+    append = next(n for n in ast.walk(fn)
+                  if isinstance(n, ast.Call)
+                  and isinstance(n.func, ast.Attribute)
+                  and n.func.attr == "append")
+    assert "_lock" in cfg.held_locks(append)
+
+
+def test_cfg_loop_else_runs_only_on_exhaustion_not_break():
+    """The while/for else body must keep the exhausted-edge pins: a
+    break path wired INTO the else block would intersect them away
+    (and hide rank-gated collectives placed in loop-else clauses)."""
+    cfg, fn = _cfg_of(
+        "def f(flag, rank):\n"
+        "    while flag:\n"
+        "        if rank != 0:\n"
+        "            break\n"
+        "    else:\n"
+        "        in_else = 1\n"
+        "    after = 1\n",
+        "f")
+    assigns = {n.targets[0].id: n for n in ast.walk(fn)
+               if isinstance(n, ast.Assign)}
+    else_info = cfg.info(assigns["in_else"])
+    # else runs only on normal exhaustion: the (flag, False) pin
+    # survives; a break edge into this block would wash it out to []
+    assert [(ast.unparse(t), pol) for (t, pol) in else_info.pins] == \
+        [("flag", False)], else_info.pins
+    after_info = cfg.info(assigns["after"])
+    assert after_info.pins == []  # join of else + break paths
+
+
+def test_full_run_reports_stale_entry_for_deleted_file(tmp_path):
+    """--strict must keep catching rotted acceptances whose FILE is
+    gone: a full run applies no scope path-filter to staleness."""
+    pkg = tmp_path / "lightgbm_tpu"
+    (pkg / "models").mkdir(parents=True)
+    (pkg / "models" / "live.py").write_text("X = 1\n")
+    baseline = tmp_path / "baseline.txt"
+    baseline.write_text(
+        "TPL001:models/deleted.py:gone:lax.scan#1  # justified once\n")
+    res = run_lint(root=str(pkg), package="lightgbm_tpu",
+                   baseline_path=str(baseline))
+    assert [e.fid for e in res.stale_baseline] == \
+        ["TPL001:models/deleted.py:gone:lax.scan#1"]
+    # ...but a narrowed (--changed-style) run stays silent about it
+    res2 = run_lint(root=str(pkg), package="lightgbm_tpu",
+                    scope={"models/live.py"},
+                    baseline_path=str(baseline))
+    assert not res2.stale_baseline
+
+
+def test_changed_relpaths_with_package_below_repo_root(tmp_path):
+    """git diff prints toplevel-relative paths; --relative keeps the
+    pre-commit gate working when the package is nested (repo/src/pkg),
+    instead of silently matching nothing."""
+    from lightgbm_tpu.analysis.cli import changed_relpaths
+
+    repo = tmp_path / "repo"
+    pkg = repo / "src" / "lightgbm_tpu"
+    (pkg / "models").mkdir(parents=True)
+    (pkg / "models" / "m.py").write_text("A = 1\n")
+    _git(repo, "init", "-q")
+    _git(repo, "add", "-A")
+    _git(repo, "commit", "-qm", "seed")
+    (pkg / "models" / "m.py").write_text("A = 2\n")
+    assert changed_relpaths(str(pkg), "HEAD") == {"models/m.py"}

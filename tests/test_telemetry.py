@@ -273,3 +273,45 @@ def test_verbosity_param_silences_info(capsys):
     out = capsys.readouterr().out
     assert "[Info]" not in out
     assert get_verbosity() == prev
+
+
+def test_fault_event_drain_is_atomic_under_concurrent_appends():
+    """Regression for the lost-event race: the recorder used to drain
+    fault logs with a bare ``list(log), []`` swap, so an event appended
+    between the copy and the clear (a watchdog abort on another thread,
+    a concurrent trainer) vanished. ``faults.drain_events`` swaps under
+    the same lock ``append_fault_event`` takes — every event must land
+    in exactly one drain."""
+    import threading
+
+    from lightgbm_tpu.resilience import faults
+
+    # isolate from any events other tests left behind
+    faults.drain_events(faults.FAULT_EVENTS)
+    n_threads, per_thread = 4, 100  # 400 < the 512 cap: nothing ages out
+    start = threading.Barrier(n_threads + 1)
+
+    def writer(tid):
+        start.wait()
+        for i in range(per_thread):
+            faults.record_fault_event(
+                "test_race", iteration=i, action="noop",
+                detail=f"t{tid}/{i}")
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    drained = []
+    start.wait()
+    while any(t.is_alive() for t in threads):
+        drained.extend(faults.drain_events(faults.FAULT_EVENTS))
+    for t in threads:
+        t.join()
+    drained.extend(faults.drain_events(faults.FAULT_EVENTS))
+    mine = [ev for ev in drained if ev["kind"] == "test_race"]
+    assert len(mine) == n_threads * per_thread, (
+        f"lost {n_threads * per_thread - len(mine)} fault events "
+        "across concurrent drains")
+    assert len({ev["detail"] for ev in mine}) == n_threads * per_thread
+    assert not faults.FAULT_EVENTS

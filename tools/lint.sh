@@ -3,6 +3,8 @@
 # any non-baselined finding AND on stale/unjustified baseline entries
 # (--strict), so CI catches both new hazards and rotted acceptances.
 # No jax import happens on this path — safe for backend-less runners.
+# Pre-commit loop: `tools/lint.sh --changed` lints only files differing
+# from HEAD (~100 ms when nothing in scope changed).
 set -eu
 cd "$(dirname "$0")/.."
 exec python -m lightgbm_tpu lint --strict \
