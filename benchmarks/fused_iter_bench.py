@@ -1,7 +1,12 @@
 """Measure the fused-iteration fast path end-to-end at bench scale
 (10.5M x 28, 255 leaves/bins) on the real chip: wall per train_one_iter
 (which now routes through _train_one_iter_fused) vs the eager path
-(fused gate forced off). Run:  python benchmarks/fused_iter_bench.py
+(fused gate forced off), plus a hist_method="pallas" arm of the fused
+path. The pallas-vs-mxu fused delta at THIS shape is the decision gate
+for flipping hist_method="auto" to pallas on TPU (docs/PALLAS.md):
+until the pallas arm measures faster here, auto keeps the mxu path
+and pallas stays opt-in (LIGHTGBM_TPU_AUTO_PALLAS=1 / hist_method=
+"pallas"). Run:  python benchmarks/fused_iter_bench.py
 """
 import os
 import sys
@@ -29,12 +34,15 @@ PARAMS = {"objective": "binary", "num_leaves": 255, "max_bin": 255,
           "learning_rate": 0.1, "verbosity": -1}
 
 
-def run(tag, fused, iters=10):
+def run(tag, fused, iters=10, hist_method=None):
     if not fused:
         orig = GBDTBooster._fused_ok
         GBDTBooster._fused_ok = lambda self: False
     try:
-        bst = lgb.Booster(params=dict(PARAMS), train_set=ds)
+        params = dict(PARAMS)
+        if hist_method:
+            params["hist_method"] = hist_method
+        bst = lgb.Booster(params=params, train_set=ds)
         eng = bst._engine
         t0 = time.perf_counter()
         eng.train_one_iter()
@@ -57,3 +65,14 @@ def run(tag, fused, iters=10):
 eager = run("eager", fused=False)
 fused = run("fused", fused=True)
 print(f"speedup: {eager / fused:.3f}x", flush=True)
+
+from lightgbm_tpu.ops.pallas_hist import pallas_available  # noqa: E402
+
+if pallas_available():
+    pallas = run("fused+pallas", fused=True, hist_method="pallas")
+    print(f"pallas vs mxu (fused): {fused / pallas:.3f}x — "
+          f"{'FLIP auto to pallas' if pallas < fused else 'keep mxu'} "
+          "(record the verdict in docs/PALLAS.md + PROFILE.md)",
+          flush=True)
+else:
+    print("pallas arm SKIPPED (unavailable)", flush=True)

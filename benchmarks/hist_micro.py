@@ -9,15 +9,23 @@ hardware, so the right strategy is an empirical question. This measures:
   onehot         - one-hot einsum riding the MXU
   segsum         - jax.ops.segment_sum with combined (f, bin) segment ids
   packed_scatter - quantized (g,h) packed into one int32 channel, flat scatter
+  pallas         - hand-tiled VMEM-resident one-hot kernel
+                   (lightgbm_tpu/ops/pallas_hist.py; the hist_method=
+                   "pallas" production path). Its time against `onehot`
+                   is the first half of the auto-flip gate; the binding
+                   number is fused_iter_bench.py's pallas arm.
 
 Run on the tunneled TPU:  python benchmarks/hist_micro.py
 Env: HM_ROWS, HM_FEATURES, HM_BINS.
 """
 
 import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 N = int(os.environ.get("HM_ROWS", 1_000_000))
 F = int(os.environ.get("HM_FEATURES", 28))
@@ -116,12 +124,26 @@ if __name__ == "__main__":
             jnp.tile(packed, (F,)), mode="drop")
         return flat.reshape(F, B)
 
+    arms = [("scan_scatter", scan_scatter),
+            ("flat_scatter", flat_scatter),
+            ("segsum", segsum),
+            ("onehot", onehot),
+            ("packed_scatter", packed_scatter)]
+
+    from lightgbm_tpu.ops.pallas_hist import (hist_from_rows_pallas,
+                                              pallas_available)
+    if pallas_available():
+        @jax.jit
+        def pallas_arm(bins_T, g, h, w):
+            gh = jnp.stack([g * w, h * w, w], axis=-1)
+            return hist_from_rows_pallas(bins_T.T, gh, B)
+
+        arms.append(("pallas", pallas_arm))
+    else:
+        print("pallas           SKIPPED (unavailable)", flush=True)
+
     results = {}
-    for name, fn in [("scan_scatter", scan_scatter),
-                     ("flat_scatter", flat_scatter),
-                     ("segsum", segsum),
-                     ("onehot", onehot),
-                     ("packed_scatter", packed_scatter)]:
+    for name, fn in arms:
         try:
             dt = timeit(fn, bins_T, grad, hess, w)
             gbs = (N * F * 1 + N * 12) / dt / 1e9

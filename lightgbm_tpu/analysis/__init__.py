@@ -20,7 +20,7 @@ invariants at review time, from the source alone:
   :mod:`~lightgbm_tpu.analysis.dataflow` adds rank taint, the
   thread-side closure, and float64-producer classification,
 - :mod:`~lightgbm_tpu.analysis.rules` runs the pluggable rule set
-  (statement-level TPL001-TPL006 plus the CFG-based TPL007-TPL009 from
+  (statement-level TPL001-TPL006 plus the CFG-based TPL007-TPL010 from
   :mod:`~lightgbm_tpu.analysis.rules_flow`; see
   docs/STATIC_ANALYSIS.md),
 - :mod:`~lightgbm_tpu.analysis.baseline` matches findings against the

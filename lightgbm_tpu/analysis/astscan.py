@@ -156,6 +156,11 @@ class ModuleScan:
         # TPL008 accepts the mark only with a non-empty why (an
         # acceptance without a reason is just a suppressed race).
         self.threadsafe_lines: Dict[int, str] = {}
+        # "# tpulint: replicated-cond <why>" — line -> justification.
+        # TPL010 accepts a device collective under a traced lax.cond
+        # only with a non-empty why naming the replicated-predicate
+        # argument (a bare mark is just a suppressed deadlock).
+        self.replicated_cond_lines: Dict[int, str] = {}
         self._scan_pragmas()
         self._collect(self.tree, [], [], None)
         self._collect_module_imports()
@@ -183,6 +188,10 @@ class ModuleScan:
                     # everything after the marker is the required why
                     why = body.split("threadsafe", 1)[1].strip()
                     self.threadsafe_lines[i] = why
+                    break
+                elif token == "replicated-cond":
+                    why = body.split("replicated-cond", 1)[1].strip()
+                    self.replicated_cond_lines[i] = why
                     break
                 else:
                     break

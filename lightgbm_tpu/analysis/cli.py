@@ -32,10 +32,11 @@ def build_parser() -> argparse.ArgumentParser:
             "are only ever entered through a jax.jit/pjit/shard_map "
             "wrapper) plus per-function CFGs with rank-taint and "
             "lock dataflow, and checks the hazard catalog "
-            "TPL001-TPL009 (eager lax loops, host syncs, recompile "
+            "TPL001-TPL010 (eager lax loops, host syncs, recompile "
             "storms, donation violations, order-unstable iteration, "
             "locks across dispatch, rank-divergent collective order, "
-            "thread-shared-state races, float64 promotion leaks). "
+            "thread-shared-state races, float64 promotion leaks, "
+            "device collectives under traced conditionals). "
             "See docs/STATIC_ANALYSIS.md."),
         epilog=EXIT_CODES,
         formatter_class=argparse.RawDescriptionHelpFormatter)
@@ -56,7 +57,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rule", metavar="TPLNNN", action="append",
                    default=None,
                    help="run only this rule (repeatable); default: "
-                        "TPL001-TPL009")
+                        "TPL001-TPL010")
     p.add_argument("--root", metavar="DIR", default=None,
                    help="package directory to analyze (default: the "
                         "installed lightgbm_tpu package)")

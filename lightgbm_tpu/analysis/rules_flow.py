@@ -1,9 +1,13 @@
-"""Flow-sensitive rules TPL007-TPL009 (CFG + dataflow based).
+"""Flow-sensitive rules TPL007-TPL010 (CFG + dataflow based).
 
 These rules sit on top of :mod:`~lightgbm_tpu.analysis.cfg` (per-
 function control-flow graphs with guard-pin and lock dataflow) and
 :mod:`~lightgbm_tpu.analysis.dataflow` (rank taint, thread-side
-closure, float64 producers), where TPL001-TPL006 are per-statement.
+closure, float64 producers), where TPL001-TPL006 are per-statement —
+except TPL010, which needs only the call graph (a device collective
+reached from a ``lax.cond``/``switch`` branch is flagged wherever it
+sits; the replicated-predicate argument lives in the pragma, not in a
+dataflow proof).
 
 Imported by :mod:`~lightgbm_tpu.analysis.rules` (which owns
 ``ALL_RULES``); import that module, not this one, to get the full rule
@@ -24,7 +28,7 @@ from .dataflow import (MUTATOR_METHODS, SYNC_PRIMITIVE_CTORS, RankTaint,
 from .rules import Finding, LintContext, Rule
 
 __all__ = ["CollectiveOrder", "ThreadSharedState", "DtypePromotionLeak",
-           "FLOW_RULES"]
+           "CollectiveUnderTracedCond", "FLOW_RULES"]
 
 
 def _src(node: ast.AST, limit: int = 58) -> str:
@@ -611,5 +615,256 @@ class DtypePromotionLeak(Rule):
         return out
 
 
+# ---------------------------------------------------------------------
+class CollectiveUnderTracedCond(Rule):
+    """TPL010: a DEVICE collective (``lax.psum`` family) inside a
+    branch of a traced conditional (``lax.cond`` / ``lax.switch``).
+
+    Under SPMD sharding, ``lax.cond`` is real control flow: only the
+    taken branch's ops execute. A collective in one branch is
+    deadlock-safe **iff the predicate is bit-identical on every
+    device** — a divergent predicate leaves part of the mesh waiting
+    in a collective the rest never joins, hanging all hosts (no error,
+    no watchdog: device collectives sit below the host-level watchdog
+    that TPL007 polices). The hazard is invisible at the call site
+    because the predicate's replication is a *global* dataflow
+    property, so this rule makes the invariant explicit: every such
+    site must carry a ``# tpulint: replicated-cond <why>`` pragma (on
+    the conditional's line or the line above) whose non-empty ``why``
+    names the argument for the predicate's replication — e.g.
+    ops/grow.py's histogram-pool reads, where ``leaf2slot`` derives
+    only from the replicated tree/argmax sequence (the ADVICE r4
+    ``_research_leafwise`` finding). A bare pragma does not suppress.
+
+    Detection is lexical + one callgraph closure: a branch argument
+    (lambda body, a referenced function/method — positional or
+    ``true_fun=``/``false_fun=``/``branches=`` keyword, including
+    ``functools.partial``-wrapped and from-import spellings) that
+    dispatches a device collective directly, or calls a package
+    function that transitively reaches one. Known out of scope: a
+    ``switch`` branch LIST built in a variable before the call (needs
+    dataflow), and collectives reached only through a function passed
+    in as an *argument* (e.g. a pool-context closure) — keep such
+    indirections out of cond branches or pragma the call site.
+    """
+
+    id = "TPL010"
+    title = "device collective under a traced conditional"
+
+    #: jax device-level collectives (basenames under jax./lax.)
+    _DEVICE_COLLECTIVES = {"psum", "pmax", "pmin", "pmean", "all_gather",
+                           "all_to_all", "ppermute", "pshuffle",
+                           "psum_scatter", "pgather"}
+    _COND_NAMES = {"cond", "switch"}
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        reaches = self._reaches_device_collective(ctx.graph)
+        # package-wide basename map: branch helpers imported from
+        # sibling modules (and method calls on package objects) must
+        # resolve too, not just same-module defs
+        global_base: Dict[str, List[Key]] = {}
+        for key in ctx.graph.funcs:
+            global_base.setdefault(key[1].rsplit(".", 1)[-1],
+                                   []).append(key)
+        for scan in ctx.scoped_scans():
+            by_base = self._funcs_by_basename(ctx, scan.relpath)
+            for node in ast.walk(scan.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                dotted = dotted_of(node.func)
+                if not dotted:
+                    continue
+                parts = dotted.split(".")
+                # bare `cond(`/`switch(` (from-import spelling) counts
+                # too: over-approximate — a shadowing local only flags
+                # when a branch actually reaches a collective
+                if parts[-1] not in self._COND_NAMES or (
+                        len(parts) > 1
+                        and parts[0] not in ("jax", "lax")):
+                    continue
+                encl = ctx.scope_of_node(scan, node.lineno)
+                hit = self._branch_collective(node, by_base,
+                                              global_base, reaches,
+                                              encl)
+                if hit is None:
+                    continue
+                why = None
+                for ln in (node.lineno, node.lineno - 1):
+                    if ln in scan.replicated_cond_lines:
+                        why = scan.replicated_cond_lines[ln]
+                        break
+                if why:  # non-empty justification accepts the site
+                    continue
+                name, via = hit
+                extra = "" if via is None \
+                    else f" (via {via}(), which reaches it through " \
+                         "the call graph)"
+                bare = "" if why is None else \
+                    " The pragma on this site has no why — state the " \
+                    "replication argument."
+                yield self._finding(
+                    ctx, scan.relpath, node,
+                    f"cond-collective:{name}",
+                    f"device collective lax.{name} runs inside a "
+                    f"branch of {parts[-1]}(){extra}: under SPMD this "
+                    "deadlocks every host unless the predicate is "
+                    "bit-identical on all devices, and nothing at "
+                    "this call site proves that. Hoist the "
+                    "collective out of the conditional, or annotate "
+                    "the line with `# tpulint: replicated-cond <why>` "
+                    "naming why the predicate is replicated (derived "
+                    "only from globally-reduced state)." + bare)
+
+    # -- helpers -------------------------------------------------------
+    def _branch_collective(self, call: ast.Call, by_base, global_base,
+                           reaches,
+                           encl: str) -> Optional[Tuple[str,
+                                                        Optional[str]]]:
+        """(collective, via_fn | None) when a branch arg reaches one.
+
+        Branches arrive positionally (``cond(pred, t, f)``), as
+        keywords (``true_fun=``/``false_fun=``/``branches=``), or as a
+        branch list for ``switch`` — all three legal call forms are
+        inspected; a branch may be a lambda, a bare name, or an
+        attribute reference (``self._helper``)."""
+        dotted = dotted_of(call.func) or ""
+        is_cond = dotted.rsplit(".", 1)[-1] == "cond"
+        branches: List[ast.AST] = []
+        if is_cond:
+            branches = list(call.args[1:3])
+        elif len(call.args) >= 2:  # switch(index, branches, *operands)
+            b = call.args[1]
+            if isinstance(b, (ast.List, ast.Tuple)):
+                branches = list(b.elts)
+        for kw in call.keywords:
+            if kw.arg in ("true_fun", "false_fun"):
+                branches.append(kw.value)
+            elif kw.arg == "branches" and isinstance(
+                    kw.value, (ast.List, ast.Tuple)):
+                branches.extend(kw.value.elts)
+        for br in branches:
+            if isinstance(br, ast.Call):
+                # functools.partial(fn, ...)-wrapped branch: inspect
+                # the wrapped function reference
+                d = dotted_of(br.func) or ""
+                if d.rsplit(".", 1)[-1] == "partial" and br.args:
+                    br = br.args[0]
+            if isinstance(br, ast.Lambda):
+                hit = self._body_collective(br.body, by_base,
+                                            global_base, reaches, encl)
+                if hit is not None:
+                    return hit
+            else:
+                name = br.id if isinstance(br, ast.Name) else (
+                    br.attr if isinstance(br, ast.Attribute) else None)
+                if name is None:
+                    continue
+                hit = self._resolve_hit(name, by_base, global_base,
+                                        reaches, encl)
+                if hit is not None:
+                    return hit
+        return None
+
+    def _body_collective(self, body: ast.AST, by_base, global_base,
+                         reaches,
+                         encl: str) -> Optional[Tuple[str,
+                                                      Optional[str]]]:
+        for sub in ast.walk(body):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = dotted_of(sub.func)
+            if not dotted:
+                continue
+            parts = dotted.split(".")
+            # bare `psum(` (from-import) counts like `lax.psum(`
+            if parts[-1] in self._DEVICE_COLLECTIVES \
+                    and (len(parts) == 1
+                         or parts[0] in ("jax", "lax")):
+                return parts[-1], None
+            if parts[0] in ("jax", "lax", "jnp", "np", "numpy",
+                            "functools"):
+                continue
+            # bare local/imported helper, or a method call
+            # (self._helper(...)): resolve the basename — same-module
+            # scoping first, any package function of that name last
+            # (over-approximate, so a refactor can't hide a collective)
+            hit = self._resolve_hit(parts[-1], by_base, global_base,
+                                    reaches, encl)
+            if hit is not None:
+                return hit[0], parts[-1]
+        return None
+
+    def _resolve_hit(self, name: str, by_base, global_base, reaches,
+                     encl: str) -> Optional[Tuple[str, Optional[str]]]:
+        """Python-scoped resolution of a function reference, checked
+        against the reaches-collective closure. Priority: the
+        innermost enclosing-scope definition of ``name`` is EXCLUSIVE
+        (proper lexical scoping — a clean local `do` never inherits a
+        sibling's collective); otherwise any same-module, then any
+        PACKAGE function of that basename counts (imported helpers,
+        methods on package objects — over-approximate by design, so a
+        refactor can't hide a collective; justified sites carry the
+        pragma)."""
+        cands = by_base.get(name, ())
+        if cands:
+            quals = {k[1]: k for k in cands}
+            parts = encl.split(".") if encl != "<module>" else []
+            for depth in range(len(parts), -1, -1):
+                q = ".".join(parts[:depth] + [name])
+                if q in quals:
+                    key = quals[q]
+                    if key in reaches:
+                        return self._closure_name(key, reaches), name
+                    return None
+        for key in list(cands) + list(global_base.get(name, ())):
+            if key in reaches:
+                return self._closure_name(key, reaches), name
+        return None
+
+    @staticmethod
+    def _funcs_by_basename(ctx: LintContext,
+                           relpath: str) -> Dict[str, List[Key]]:
+        out: Dict[str, List[Key]] = {}
+        for key in ctx.graph.funcs:
+            if key[0] == relpath:
+                out.setdefault(key[1].rsplit(".", 1)[-1],
+                               []).append(key)
+        return out
+
+    @staticmethod
+    def _closure_name(key: Key, reaches) -> str:
+        return reaches.get(key) or "psum"
+
+    @staticmethod
+    def _reaches_device_collective(graph: CallGraph) -> Dict[Key, str]:
+        """key -> the device collective it (transitively) dispatches."""
+        direct: Dict[Key, str] = {}
+        for scope, facts in graph.facts.items():
+            if scope is None:
+                continue
+            for rec in facts.records:
+                if rec.kind == "ext" and rec.dotted:
+                    parts = rec.dotted.split(".")
+                    if parts[-1] in \
+                            CollectiveUnderTracedCond._DEVICE_COLLECTIVES \
+                            and parts[0] in ("jax", "lax"):
+                        direct.setdefault(scope, parts[-1])
+        callers: Dict[Key, Set[Optional[Key]]] = {}
+        for scope, facts in graph.facts.items():
+            for rec in facts.records:
+                if rec.kind == "known" and rec.target is not None:
+                    callers.setdefault(rec.target, set()).add(scope)
+        out = dict(direct)
+        frontier = list(direct)
+        while frontier:
+            k = frontier.pop()
+            for caller in callers.get(k, ()):
+                if caller is not None and caller not in out:
+                    out[caller] = out[k]
+                    frontier.append(caller)
+        return out
+
+
 FLOW_RULES: List[Rule] = [CollectiveOrder(), ThreadSharedState(),
-                          DtypePromotionLeak()]
+                          DtypePromotionLeak(),
+                          CollectiveUnderTracedCond()]

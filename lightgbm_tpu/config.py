@@ -498,24 +498,36 @@ class Config:
     num_devices: int = 0  # 0 = use all visible devices for data-parallel
     hist_dtype: str = "float32"  # histogram accumulator dtype
     sharding_axis: str = "data"  # mesh axis name for row sharding
-    # histogram build strategy: auto|scatter|mxu (auto: nibble
-    # matmul on TPU — rides the MXU — and scatter-add on CPU)
+    # histogram build strategy: auto|scatter|mxu|pallas. auto: nibble
+    # matmul (MXU) on TPU and scatter-add on CPU; pallas: hand-tiled
+    # TPU kernel accumulating the [F, B, 2] histogram in VMEM
+    # (ops/pallas_hist.py; runs under the Pallas interpreter on CPU).
+    # Flipping auto to pallas on TPU is gated on a measured iters/sec
+    # win on the Higgs-shaped bench (LIGHTGBM_TPU_AUTO_PALLAS=1 opts
+    # in; see docs/PALLAS.md). Falls back mxu -> scatter under the OOM
+    # degradation ladder or when Pallas is unavailable.
     hist_method: str = "auto"
     # MXU histogram accumulation passes: default (single-pass bf16 input /
     # f32 accumulation — the reference GPU learner's single-precision
     # histogram choice, docs/GPU-Performance.rst:134-158) | high (3-pass)
     # | highest (6-pass f32 emulation)
     hist_precision: str = "default"
-    # tree grower: compact (the flagship: rows grouped by leaf,
-    # per-split work ~ leaf size) | masked (full-row masked histogram
-    # passes). "masked" is a deliberately simple CORRECTNESS ORACLE
+    # tree grower: compact (the flagship: leaf-wise, rows grouped by
+    # leaf, per-split work ~ leaf size) | level (DEPTH-wise: the whole
+    # frontier splits per step, histograms built in one batched
+    # sibling-subtracting pass per level — O(rows) histogram work per
+    # LEVEL instead of per split; trees are balanced-by-policy, so
+    # they differ from leaf-wise trees whenever the leaf budget binds)
+    # | masked (full-row masked histogram passes). "masked" is a
+    # deliberately simple CORRECTNESS ORACLE
     # kept for differential testing (tests/test_grower_equivalence.py),
     # not a performance choice: every split pays O(n) histogram work,
     # and it lacks EFB / CEGB / interaction / forced splits /
     # path-smooth / bynode / quantized — configs needing those either
     # auto-upgrade to compact (quantized, forced, bynode, path-smooth;
     # see GBDTBooster.__init__) or raise NotImplementedError
-    # (grow_tree_impl), and >50M row*leaf products raise outright
+    # (grow_tree_impl), and >50M row*leaf products raise outright.
+    # "level" shares masked's feature gating (core set only).
     grower: str = "compact"
     # rows per streaming chunk in the compact grower's partition pass
     # (perf knob; power of two. Larger chunks amortize per-chunk fixed
@@ -599,8 +611,10 @@ class Config:
             raise ValueError(
                 f"Unknown monotone_constraints_method: "
                 f"{self.monotone_constraints_method}")
-        if self.hist_method not in ("auto", "scatter", "mxu"):
+        if self.hist_method not in ("auto", "scatter", "mxu", "pallas"):
             raise ValueError(f"Unknown hist_method: {self.hist_method}")
+        if self.grower not in ("compact", "masked", "level"):
+            raise ValueError(f"Unknown grower: {self.grower}")
         if self.chunk_rows < 256 or (self.chunk_rows
                                      & (self.chunk_rows - 1)) != 0:
             raise ValueError("chunk_rows must be a power of two >= 256, "

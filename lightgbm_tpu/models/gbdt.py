@@ -37,7 +37,52 @@ from ..ops.split import SplitParams
 from .tree import (Tree, pack_tree_device, tree_from_arrays,
                    unpack_tree_host)
 
-__all__ = ["GBDTBooster"]
+__all__ = ["GBDTBooster", "resolve_hist_method"]
+
+
+def resolve_hist_method(requested: str, backend: Optional[str] = None,
+                        pallas_ok: Optional[bool] = None) -> str:
+    """Concrete histogram method from the Config value.
+
+    ``auto`` resolves to scatter on CPU and the MXU nibble matmul on
+    accelerators. The Pallas kernel (ops/pallas_hist.py) is preferred
+    by ``auto`` on TPU only when ``LIGHTGBM_TPU_AUTO_PALLAS=1``: the
+    flip is gated on a measured iters/sec win on the Higgs-shaped
+    bench at 255 leaves/255 bins (benchmarks/fused_iter_bench.py grows
+    the pallas arm; docs/PALLAS.md records the gate) — interpret-mode
+    parity alone does not flip the default. An explicit
+    ``hist_method="pallas"`` on an environment where Pallas is
+    unavailable falls back to the ``auto`` resolution with a warning
+    instead of failing the run.
+    """
+    import os
+
+    if backend is None:
+        # tpu may surface as platform "tpu" or a tunneled plugin name
+        backend = jax.default_backend()
+
+    def _pallas_ok():
+        # probed lazily: the default scatter/mxu resolutions must not
+        # pay the jax.experimental.pallas import at engine init
+        nonlocal pallas_ok
+        if pallas_ok is None:
+            from ..ops.pallas_hist import pallas_available
+            pallas_ok = pallas_available()
+        return pallas_ok
+
+    if requested == "pallas" and not _pallas_ok():
+        from ..utils.log import log_warning
+        log_warning("hist_method='pallas' requested but Pallas is "
+                    "unavailable; falling back to the auto resolution")
+        requested = "auto"
+    if requested != "auto":
+        return requested
+    if backend == "cpu":
+        return "scatter"
+    if os.environ.get("LIGHTGBM_TPU_AUTO_PALLAS") == "1" \
+            and _pallas_ok():
+        return "pallas"
+    return "mxu"
 
 # non-finite guard (resilience): flag bits and the clamp ceiling
 # (well inside float32 range so downstream sums stay finite)
@@ -208,11 +253,19 @@ class GBDTBooster:
                 self.K, self.n)
         self.score = score0
 
-        hist_method = cfg.hist_method
-        if hist_method == "auto":
-            # tpu may surface as platform "tpu" or a tunneled plugin name
-            hist_method = ("scatter" if jax.default_backend() == "cpu"
-                           else "mxu")
+        hist_method = resolve_hist_method(cfg.hist_method)
+        if hist_method == "pallas" and cfg.hist_precision != "default":
+            # the multi-pass f32 emulation is MXU-path machinery; the
+            # Pallas kernel always runs its single-pass f32-accumulate
+            # numerics (docs/PALLAS.md) — say so instead of silently
+            # ignoring the knob
+            from ..utils.log import log_warning
+            log_warning(
+                f"hist_precision='{cfg.hist_precision}' applies to "
+                "hist_method='mxu' only; the pallas kernel runs its "
+                "single-pass f32-accumulation numerics (and an OOM "
+                "degradation to mxu would re-enable the multi-pass "
+                "emulation mid-run)")
         grower = cfg.grower
         if cfg.use_quantized_grad and grower != "compact":
             grower = "compact"  # quantized histograms are compact-only
@@ -537,10 +590,11 @@ class GBDTBooster:
 
     def _run_with_oom_degrade(self, thunk, what: str):
         """Run a grow/fused dispatch with graceful OOM degradation:
-        on RESOURCE_EXHAUSTED, downgrade the histogram strategy (MXU
-        matmul -> scatter, then histogram-pool halving), rebuild the
-        affected jitted programs and retry; re-raise as a clear
-        LightGBMError once nothing is left to shed."""
+        on RESOURCE_EXHAUSTED, downgrade the histogram strategy
+        (Pallas kernel -> MXU matmul -> scatter, then histogram-pool
+        halving), rebuild the affected jitted programs and retry;
+        re-raise as a clear LightGBMError once nothing is left to
+        shed."""
         while True:
             try:
                 self._fault_plan.maybe_oom(self.iter_)
@@ -558,7 +612,13 @@ class GBDTBooster:
     def _degrade_after_oom(self, exc, what: str) -> bool:
         """Apply one degradation step; False when exhausted."""
         gcfg = self.grow_cfg
-        if gcfg.hist_method == "mxu":
+        if gcfg.hist_method == "pallas":
+            # first rung of the ladder: shed the VMEM-resident kernel
+            # (its one-hot scratch block is the newest allocation) and
+            # fall back to the XLA-generated MXU path
+            self.grow_cfg = gcfg._replace(hist_method="mxu")
+            action = "hist_method pallas -> mxu"
+        elif gcfg.hist_method == "mxu":
             self.grow_cfg = gcfg._replace(hist_method="scatter")
             action = "hist_method mxu -> scatter"
         else:

@@ -358,6 +358,23 @@ def grow_tree_impl(cfg: GrowConfig,
                                   monotone_constraints, feat_is_cat,
                                   quant_key, interaction_groups, forced,
                                   cegb_arrays, node_key, bundle_arrays)
+    if cfg.grower == "level":
+        if cfg.bundled or interaction_groups is not None \
+                or forced is not None or cegb_arrays is not None \
+                or cfg.quantized or cfg.bynode < 1.0 \
+                or cfg.split.path_smooth > 0.0 \
+                or cfg.hist_pool_slots > 0 \
+                or (cfg.axis_name is not None
+                    and cfg.parallel_mode != "data"):
+            raise NotImplementedError(
+                "grower='level' covers the core feature set only (no "
+                "EFB/interaction/forced/CEGB/quantized/bynode/"
+                "path-smooth/histogram-pool; data-parallel sharding "
+                "only) — use grower='compact'")
+        return _grow_level_impl(cfg, bins_T, grad, hess, row_weight,
+                                feature_mask, feat_num_bins,
+                                feat_nan_bin, monotone_constraints,
+                                feat_is_cat)
     if cfg.bundled:
         raise NotImplementedError(
             "EFB bundling requires the compact grower")
@@ -490,9 +507,273 @@ def _grow_masked_impl(cfg: GrowConfig,
 
     def step(_, state: _GrowState) -> _GrowState:
         can = jnp.max(state.best.gain) > 0.0
+        # tpulint: replicated-cond best.gain comes from psum-reduced histograms, so `can` is bit-identical on every device
         return lax.cond(can, do_split, lambda s: s, state)
 
     state = lax.fori_loop(0, L - 1, step, state)
+    return state.tree, state.row_leaf
+
+
+# ---------------------------------------------------------------------------
+# Level grower: depth-wise growth, one fused step per frontier level
+# ---------------------------------------------------------------------------
+
+class _LevelState(NamedTuple):
+    tree: TreeArrays
+    best: _BestSplits
+    hists: jnp.ndarray       # [L, F, B, 2]
+    row_leaf: jnp.ndarray    # [n] i32
+    num_splits: jnp.ndarray  # scalar i32
+    level: jnp.ndarray       # scalar i32 — depth of the current frontier
+
+
+def _grow_level_impl(cfg: GrowConfig,
+                     bins_T: jnp.ndarray,
+                     grad: jnp.ndarray,
+                     hess: jnp.ndarray,
+                     row_weight: jnp.ndarray,
+                     feature_mask: jnp.ndarray,
+                     feat_num_bins: jnp.ndarray,
+                     feat_nan_bin: jnp.ndarray,
+                     monotone_constraints: Optional[jnp.ndarray] = None,
+                     feat_is_cat: Optional[jnp.ndarray] = None):
+    """Depth-wise (level-order) growth with the whole frontier fused
+    into ONE loop iteration per level — the GPU tree-boosting pipeline
+    shape (arXiv:1706.08359 §4, arXiv:2011.02022 "Booster") on the
+    masked-state layout.
+
+    Where the leaf-wise growers alternate argmax -> split -> re-score
+    once per SPLIT (each hop round-tripping an ``[F, B, 2]`` histogram
+    and an ``[n]`` leaf mask through HBM between separately-fused op
+    islands), one level step here:
+
+    1. elects every frontier leaf whose stored best gain is positive
+       (gain-ranked when the remaining ``num_leaves`` budget can't take
+       the whole frontier — the depth-wise analog of leaf-wise's
+       global argmax),
+    2. partitions the rows of ALL elected leaves,
+    3. builds the level's child histograms in one batched pass over
+       the rows of the (estimated-smaller) children only — one
+       leaf-segmented scatter pass for ``hist_method="scatter"``, one
+       masked kernel pass per small child for the MXU/Pallas methods —
+       with every sibling recovered by subtraction, and
+    4. scores best splits for the whole new frontier in ONE vmapped
+       ``find_best_split`` batch over the ``[L, F, B, 2]`` cache.
+
+    The whole tree is a single traced program (a ``lax.while_loop``
+    with one iteration per level), so histogram -> best-split ->
+    partition never crosses a dispatch boundary. With
+    ``hist_method="scatter"`` the leaf-segmented pass makes total
+    histogram work O(rows) per LEVEL instead of O(rows) per split;
+    the mxu/pallas paths keep per-splitting-child masked passes (no
+    segment axis in those kernels yet — see the note in step 3), so
+    there the win is the fusion, sibling subtraction, and per-level
+    batched scoring, not asymptotic histogram work. Depth-wise
+    trees differ from leaf-wise trees whenever the leaf budget binds
+    before the frontier is exhausted — that is the point of the mode
+    (the reference's ``growing policy``), not a numerical gap; with a
+    non-binding budget both policies split the identical leaf set.
+
+    Supports the core feature set (numeric + categorical splits,
+    bagging weights, max_depth, data-parallel ``axis_name`` psums);
+    the flagship compact grower keeps everything else.
+    """
+    L = cfg.num_leaves
+    B = cfg.num_bins
+    F = bins_T.shape[0]
+    n = bins_T.shape[1]
+    dtype = grad.dtype
+    p = cfg.split
+    has_cat = feat_is_cat is not None
+    hmethod = cfg.hist_method \
+        if cfg.hist_method in ("scatter", "pallas") else "mxu"
+
+    def psum(x):
+        return lax.psum(x, cfg.axis_name) if cfg.axis_name else x
+
+    def best_for(hist, sg, sh, sc):
+        return find_best_split(hist, sg, sh, sc, feat_num_bins,
+                               feat_nan_bin, feature_mask, p,
+                               monotone_constraints, feat_is_cat)
+
+    def depth_ok(d):
+        if cfg.max_depth <= 0:
+            return jnp.asarray(True)
+        return d < cfg.max_depth
+
+    # ---- root ----
+    w = row_weight.astype(dtype)
+    inbag = row_weight > 0
+    gh = jnp.stack([grad * w, hess * w], axis=-1)          # [n, 2]
+    total_g = psum(jnp.sum(gh[:, 0]))
+    total_h = psum(jnp.sum(gh[:, 1]))
+    total_c = psum(jnp.sum(inbag.astype(dtype)))
+    all_rows = jnp.ones((n,), jnp.bool_)
+    root_hist = psum(build_histogram(bins_T, grad, hess, row_weight,
+                                     all_rows, B, hmethod,
+                                     cfg.hist_precision))
+    tree = _init_tree(L, B, dtype)
+    tree = tree._replace(
+        leaf_value=tree.leaf_value.at[0].set(
+            leaf_output(total_g, total_h, p)),
+        leaf_weight=tree.leaf_weight.at[0].set(total_h),
+        leaf_count=tree.leaf_count.at[0].set(total_c),
+    )
+    best = _BestSplits.init(L, B, dtype)
+    best = best.store(0, best_for(root_hist, total_g, total_h, total_c),
+                      jnp.asarray(True))
+    hists = jnp.zeros((L, F, B, 2), dtype).at[0].set(root_hist)
+    state = _LevelState(tree=tree, best=best, hists=hists,
+                        row_leaf=jnp.zeros((n,), jnp.int32),
+                        num_splits=jnp.asarray(0, jnp.int32),
+                        level=jnp.asarray(0, jnp.int32))
+    slots = jnp.arange(L, dtype=jnp.int32)
+
+    def level_step(state: _LevelState) -> _LevelState:
+        tree, best, hists, row_leaf, ns, level = state
+
+        # -- 1. elect the level's splits, gain-ranked under the budget --
+        active = slots < tree.num_leaves
+        frontier = active & (tree.leaf_depth == level)
+        cand = frontier & (best.gain > 0.0)
+        capacity = jnp.asarray(L - 1, jnp.int32) - ns
+        order = jnp.argsort(jnp.where(cand, -best.gain, jnp.inf))
+        rank = jnp.argsort(order).astype(jnp.int32)
+        splitting = cand & (rank < capacity)
+        # node ids / right-child slots in slot order (creation order is
+        # a labeling choice; the Tree convention only needs left child
+        # = parent slot, right child = next free slot)
+        ordn = jnp.cumsum(splitting.astype(jnp.int32)) - 1
+        node_ids = ns + ordn
+        r_slots = jnp.clip(ns + 1 + ordn, 0, L - 1)
+
+        # -- 2. partition every elected leaf's rows (the level's single
+        # DataPartition::Split sweep) + record the split in the tree --
+        def split_one(l, carry):
+            def do(carry):
+                tree, best, row_leaf = carry
+                R = r_slots[l]
+                f = best.feature[l]
+                t = best.threshold_bin[l]
+                dl = best.default_left[l]
+                col = lax.dynamic_index_in_dim(
+                    bins_T, f, axis=0, keepdims=False).astype(jnp.int32)
+                nanb = feat_nan_bin[f]
+                gl = jnp.where((nanb >= 0) & (col == nanb), dl, col <= t)
+                if has_cat:
+                    gl = jnp.where(best.is_cat[l], best.cat_mask[l][col],
+                                   gl)
+                on_leaf = row_leaf == l
+                nl_ex = psum(jnp.sum(
+                    (on_leaf & gl & inbag).astype(dtype)))
+                nr_ex = tree.leaf_count[l] - nl_ex
+                row_leaf = jnp.where(on_leaf & ~gl, R, row_leaf)
+                tree = _apply_split_to_tree(tree, best, l, R,
+                                            node_ids[l], p, nl_ex, nr_ex)
+                return tree, best, row_leaf
+
+            # COLLECTIVE-IN-COND INVARIANT (data-parallel): the taken
+            # branch psums the exact left count; `splitting` derives
+            # only from globally-reduced histograms and the
+            # deterministic election, so every device takes the same
+            # branch sequence.
+            # tpulint: replicated-cond splitting is a pure function of replicated state
+            return lax.cond(splitting[l], do, lambda c: c, carry)
+
+        tree, best, row_leaf = lax.fori_loop(
+            0, L, split_one, (tree, best, row_leaf))
+
+        # -- 3. the level's child histograms: one batched pass over the
+        # (estimated-smaller) children's rows; siblings by subtraction --
+        left_cnt = tree.leaf_count                       # [L] post-split
+        right_cnt = tree.leaf_count[r_slots]
+        left_small = left_cnt <= right_cnt
+        small_slot = jnp.where(left_small, slots, r_slots)
+        drop = jnp.asarray(L, jnp.int32)
+        is_small = jnp.zeros((L,), jnp.bool_).at[
+            jnp.where(splitting, small_slot, drop)].set(True, mode="drop")
+
+        if hmethod == "scatter":
+            # leaf-segmented scatter: ONE pass over all rows builds
+            # every small child's histogram at once (segment id =
+            # row_leaf, payload masked to small-child rows)
+            seg = row_leaf
+            m = is_small[seg].astype(dtype)[:, None]     # [n, 1]
+            pay = gh * m
+
+            def seg_body(carry, bins_f):
+                idx = seg * B + bins_f.astype(jnp.int32)
+                h = jnp.zeros((L * B, 2), dtype).at[idx].add(
+                    pay, mode="drop")
+                return carry, h
+
+            _, h_f = lax.scan(seg_body, None, bins_T)    # [F, L*B, 2]
+            small_hists = psum(
+                h_f.reshape(F, L, B, 2).transpose(1, 0, 2, 3))
+        else:
+            # MXU / Pallas kernels have no segment axis: one masked
+            # kernel pass per small child, cond-skipped for idle
+            # slots. NB: each taken pass streams the FULL bin matrix
+            # with the other leaves' payload zeroed, so per-level hist
+            # cost on these paths is (#splitting children) x O(n*F) —
+            # the fusion/sibling-subtraction/batched-scoring wins
+            # apply, but the O(rows)-per-level property belongs to the
+            # scatter segment pass above. A segment-aware kernel pass
+            # (gather the small child's rows first) is the open
+            # follow-up for the TPU paths.
+            def hist_one(l, acc):
+                def do(acc):
+                    mask = row_leaf == small_slot[l]
+                    h = psum(build_histogram(bins_T, grad, hess,
+                                             row_weight, mask, B,
+                                             hmethod,
+                                             cfg.hist_precision))
+                    return lax.dynamic_update_index_in_dim(
+                        acc, h, small_slot[l], axis=0)
+
+                # tpulint: replicated-cond splitting is replicated (see the partition sweep)
+                return lax.cond(splitting[l], do, lambda a: a, acc)
+
+            small_hists = lax.fori_loop(
+                0, L, hist_one, jnp.zeros((L, F, B, 2), dtype))
+
+        def sib_one(l, hists):
+            def do(hists):
+                R = r_slots[l]
+                parent = hists[l]
+                small = lax.dynamic_index_in_dim(
+                    small_hists, small_slot[l], keepdims=False)
+                other = subtract_histogram(parent, small)
+                lh = jnp.where(left_small[l], small, other)
+                rh = jnp.where(left_small[l], other, small)
+                return hists.at[l].set(lh).at[R].set(rh)
+
+            return lax.cond(splitting[l], do, lambda h: h, hists)
+
+        hists = lax.fori_loop(0, L, sib_one, hists)
+
+        # -- 4. score the whole new frontier in one vmapped batch;
+        # every other slot (including just-retired frontier leaves that
+        # didn't make the election) drops to -inf and never splits --
+        sums = hists[:, 0].sum(axis=1)                   # [L, 2]
+        r = jax.vmap(best_for)(hists, sums[:, 0], sums[:, 1],
+                               tree.leaf_count)
+        is_child = (slots < tree.num_leaves) \
+            & (tree.leaf_depth == level + 1)
+        allowed = is_child & depth_ok(level + 1)
+        best = _BestSplits(jnp.where(allowed, r.gain, NEG_INF),
+                           *tuple(r)[1:])
+        return _LevelState(tree=tree, best=best, hists=hists,
+                           row_leaf=row_leaf,
+                           num_splits=ns + jnp.sum(
+                               splitting.astype(jnp.int32)),
+                           level=level + 1)
+
+    def can_grow(state: _LevelState):
+        return (state.num_splits < L - 1) \
+            & jnp.any(state.best.gain > 0.0)
+
+    state = lax.while_loop(can_grow, level_step, state)
     return state.tree, state.row_leaf
 
 
@@ -1096,8 +1377,10 @@ def _grow_compact_impl(cfg: GrowConfig,
     w = row_weight.astype(dtype)
     inbag = row_weight > 0
     gw2 = jnp.stack([grad * w, hess * w], axis=-1)  # [n, 2]
-    # "onehot" has no gathered-rows analog; it maps to the MXU kernel
-    hmethod = "scatter" if cfg.hist_method == "scatter" else "mxu"
+    # scatter and pallas pass through; anything else ("onehot" legacy
+    # spelling included) maps to the MXU nibble kernel
+    hmethod = cfg.hist_method \
+        if cfg.hist_method in ("scatter", "pallas") else "mxu"
 
     quant = cfg.quantized
     if quant:
@@ -1847,7 +2130,9 @@ def _grow_compact_impl(cfg: GrowConfig,
                 # hit branch's cached hists are likewise already
                 # globally reduced). Never feed device-dependent
                 # inputs into the pool bookkeeping: a divergent
-                # predicate would hang all hosts, not raise.
+                # predicate would hang all hosts, not raise. TPL010
+                # holds this invariant at review time.
+                # tpulint: replicated-cond leaf2slot is pool state derived only from the replicated tree/argmax sequence
                 hist = lax.cond(
                     slot >= 0,
                     lambda: lax.dynamic_index_in_dim(
@@ -1945,6 +2230,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         if pooled:
             leaf2slot, slot2leaf, lru = pool_st
             slot_l = leaf2slot[leaf]
+            # tpulint: replicated-cond leaf2slot derives only from the replicated tree/argmax sequence (see _research_leafwise)
             parent_hist = lax.cond(
                 slot_l >= 0,
                 lambda: lax.dynamic_index_in_dim(
@@ -2282,6 +2568,7 @@ def _grow_compact_impl(cfg: GrowConfig,
             else (state.mono[0][leaf], state.mono[1][leaf])
         if pooled:
             slot = state.pool[0][leaf]
+            # tpulint: replicated-cond leaf2slot derives only from the replicated tree/argmax sequence (see _research_leafwise)
             hist_l = lax.cond(
                 slot >= 0,
                 lambda: lax.dynamic_index_in_dim(
@@ -2298,6 +2585,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         valid = ok & (r.left_count > 0) & (r.right_count > 0)
         forced_state = state._replace(best=state.best.store(leaf, r,
                                                             jnp.asarray(True)))
+        # tpulint: replicated-cond `valid` derives from the forced-split record on globally-reduced histograms
         return lax.cond(valid,
                         lambda s: do_split(s, leaf_override=leaf),
                         lambda _: state, forced_state), valid

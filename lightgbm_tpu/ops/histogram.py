@@ -133,6 +133,13 @@ def _hist_from_rows_impl(rows: jnp.ndarray, payload: jnp.ndarray,
     if method == "scatter":
         return _hist_scatter(rows.T, payload.astype(accum_dtype), num_bins)
     int_exact = jnp.issubdtype(accum_dtype, jnp.integer)
+    if method == "pallas":
+        # VMEM-resident one-hot kernel (ops/pallas_hist.py). Always
+        # f32-accumulated (int8 payloads: exact int32) — the
+        # hist_precision multi-pass emulation is an MXU-path knob.
+        from .pallas_hist import hist_from_rows_pallas
+        return hist_from_rows_pallas(rows, payload, num_bins,
+                                     int_exact=int_exact)
     S, F = rows.shape
     C = payload.shape[-1]
     s_hi = -(-num_bins // S_LO)
@@ -180,7 +187,8 @@ def hist_from_rows(rows: jnp.ndarray, payload: jnp.ndarray,
       rows: ``[S, F]`` integer bin matrix (row-major).
       payload: ``[S, C]`` float per-row channels (grad, hess).
       num_bins: B.
-      method: "mxu" (nibble matmul) or "scatter" (CPU-friendly).
+      method: "mxu" (nibble matmul), "pallas" (VMEM-resident one-hot
+        kernel, ops/pallas_hist.py) or "scatter" (CPU-friendly).
       precision: matmul pass count — "default" (1-pass bf16/f32-accum),
         "high" (3-pass), "highest" (6-pass); mxu path only.
     Returns:
@@ -198,12 +206,6 @@ def hist_from_rows_int(rows: jnp.ndarray, payload: jnp.ndarray,
     (subtraction-safe) via bf16 MXU passes with per-block conversion."""
     return _hist_from_rows_impl(rows, payload, num_bins, method, jnp.int32,
                                 None)
-
-
-def _hist_mxu(bins_T: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
-              precision: str = "default") -> jnp.ndarray:
-    """Full-pass MXU histogram from the feature-major bin matrix."""
-    return hist_from_rows(bins_T.T, gh, num_bins, precision=precision)
 
 
 def _hist_scatter(bins_T: jnp.ndarray, gh: jnp.ndarray, num_bins: int,
@@ -242,8 +244,8 @@ def build_histogram(bins_T: jnp.ndarray,
     """
     m = mask.astype(grad.dtype) * row_weight.astype(grad.dtype)
     gh = jnp.stack([grad * m, hess * m], axis=-1)  # [n, 2]
-    if method == "mxu":
-        return _hist_mxu(bins_T, gh, num_bins, precision)
+    if method in ("mxu", "pallas"):
+        return hist_from_rows(bins_T.T, gh, num_bins, method, precision)
     return _hist_scatter(bins_T, gh, num_bins)
 
 

@@ -1,0 +1,230 @@
+"""Hand-tiled Pallas TPU histogram kernel (``hist_method="pallas"``).
+
+The MXU nibble path (histogram.py) materializes its HI/LO one-hot
+operands through HBM — the measured cost center of the whole histogram
+(~25 us of one-hot broadcast/compare per 16K-row chunk on v5e against
+~22 us of einsum, benchmarks/PROFILE.md). This kernel builds the
+one-hot *inside* the kernel body, so it only ever exists in VMEM:
+
+- **Grid** = ``(feature_packs, row_tiles)``. The row-tile dimension is
+  innermost, so the ``[C, FPACK, B]`` output block stays VMEM-resident
+  across the whole row sweep of one feature pack (initialized at tile
+  0, accumulated in f32 thereafter) while Pallas double-buffers the
+  ``[ROW_TILE, FPACK]`` bin-column and ``[ROW_TILE, C]`` payload blocks
+  through VMEM — the bin matrix streams HBM -> VMEM exactly once per
+  feature pack and nothing histogram-shaped ever goes back until the
+  final ``[C, F, B]`` result (a few hundred KB).
+- **Compute**: the per-tile one-hot ``[ROW_TILE, FPACK * B]`` feeds ONE
+  ``dot_general`` against the ``[ROW_TILE, C]`` payload with
+  f32 ``preferred_element_type`` — N = FPACK*B lanes (2048 at B=256:
+  16 full lane tiles), K = ROW_TILE. Features live in the N dimension,
+  so no cross-feature garbage is computed (the MXU path burns PACK x
+  PACK blocks to keep a diagonal) and no sub-lane reshape/diagonal
+  extraction is needed — the two Mosaic cliffs that killed the earlier
+  prototype (PROFILE.md "rejected routes").
+- **Tiling**: B pads up to a 128-lane multiple; ROW_TILE is sized so
+  the one-hot block stays ~4 MiB of VMEM (1024 rows at B<=128, 512 at
+  B=256), leaving room for Pallas' input double buffers.
+- **Exactness**: float payloads accumulate in f32 (on TPU the MXU's
+  default single-pass mode reads the f32 one-hot/payload as bf16 — the
+  same numerics class as the mxu path's documented default). int8
+  quantized payloads are EXACT int32: each <=131072-row super-block's
+  f32 sums are exact integers (131072 * 127 < 2^24) and blocks are
+  converted to int32 before the cross-block sum, mirroring the mxu
+  path's per-ROW_BLOCK conversion.
+
+CPU correctness (tier-1) runs the SAME kernel under
+``pallas_call(..., interpret=True)``; parity with the mxu and scatter
+paths is asserted by tests/test_pallas_hist.py. On-chip iters/sec on
+the Higgs-shaped bench (255 leaves / 255 bins) is the gate for
+flipping ``hist_method="auto"`` to pallas on TPU
+(benchmarks/fused_iter_bench.py grows the pallas arm); until a
+measured win lands in PROFILE.md, ``auto`` keeps the mxu path and
+pallas is opt-in. docs/PALLAS.md records the tiling rationale.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["pallas_available", "hist_from_rows_pallas", "FPACK",
+           "INT_BLOCK"]
+
+FPACK = 8        # feature columns per grid cell: FPACK * 128-padded-B
+                 # output lanes per dot (2048 at B=256 — 16 lane tiles)
+INT_BLOCK = 131072   # rows per int-exact super-block: 131072 * 127
+                     # = 1.66e7 < 2^24, so every f32 partial sum of an
+                     # int8 payload is an exact integer
+_ONEHOT_VMEM = 4 * 2 ** 20   # one-hot block VMEM budget (bytes)
+
+_pallas_mod = None
+_pallas_checked = False
+
+
+def pallas_available() -> bool:
+    """Whether the Pallas kernel can be built in this environment.
+
+    True when ``jax.experimental.pallas`` imports (the kernel runs
+    natively on TPU and under ``interpret=True`` everywhere else).
+    ``LIGHTGBM_TPU_DISABLE_PALLAS=1`` forces False — the operational
+    kill switch the ``auto``/OOM-ladder fallback paths key on."""
+    global _pallas_mod, _pallas_checked
+    if os.environ.get("LIGHTGBM_TPU_DISABLE_PALLAS", "") == "1":
+        return False
+    if not _pallas_checked:
+        _pallas_checked = True
+        try:
+            from jax.experimental import pallas as pl  # noqa: F401
+            _pallas_mod = pl
+        except Exception:  # pragma: no cover - env without pallas
+            _pallas_mod = None
+    return _pallas_mod is not None
+
+
+def _tile_plan(bp: int):
+    """(fpack, row_tile) keeping the f32 one-hot block
+    [RT, fpack, BP] under the VMEM budget: shrink the feature pack
+    first at very wide B (bundled bin-position counts), then the row
+    tile (power of two; floor 8 = the f32 sublane minimum, reached
+    only past bp = 128K where even fpack=1 rows are that wide)."""
+    fp = FPACK
+    while fp > 1 and 128 * fp * bp * 4 > _ONEHOT_VMEM:
+        fp //= 2
+    rt = _ONEHOT_VMEM // (fp * bp * 4)      # rows fitting the budget
+    if rt < 8:
+        return fp, 8   # bp > 128K: a >1 GB histogram; floor the tile
+    return fp, min(1024, 1 << (rt.bit_length() - 1))
+
+
+def _require_pallas():
+    """The imported pallas module, or a clear error when the kernel
+    cannot be built here (single cache: pallas_available())."""
+    if not pallas_available():
+        raise RuntimeError(
+            "hist_method='pallas' requested but jax.experimental."
+            "pallas is unavailable (or LIGHTGBM_TPU_DISABLE_PALLAS"
+            "=1); use hist_method='auto'|'mxu'|'scatter'")
+    return _pallas_mod
+
+
+def _hist_kernel(bins_ref, pay_ref, out_ref, *, bp: int, fpack: int,
+                 row_tile: int):
+    """One (feature-pack, row-tile) grid cell.
+
+    ``out_ref`` is the pack's [C, fpack, BP] f32 accumulator — the same
+    block for every row tile (the grid's innermost dimension), so it
+    lives in VMEM across the whole row sweep."""
+    pl = _require_pallas()
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    bins = bins_ref[...].astype(jnp.int32)            # [RT, fpack]
+    pay = pay_ref[...]                                # [RT, C]
+    c = pay.shape[-1]
+    iota_b = lax.broadcasted_iota(jnp.int32, (row_tile, fpack, bp), 2)
+    onehot = (bins[:, :, None] == iota_b).astype(jnp.float32)
+    # [C, fpack*BP] = pay^T @ onehot, contracting the row dimension:
+    # features ride the N (lane) dimension so nothing off-diagonal is
+    # computed, and the one-hot never leaves VMEM
+    acc = lax.dot_general(pay.astype(jnp.float32),
+                          onehot.reshape(row_tile, fpack * bp),
+                          (((0,), (0,)), ((), ())),
+                          preferred_element_type=jnp.float32)
+    out_ref[...] += acc.reshape(c, fpack, bp)
+
+
+def _hist_tiles(rows: jnp.ndarray, payload: jnp.ndarray, num_bins: int,
+                interpret: bool) -> jnp.ndarray:
+    """One pallas_call over the whole [S, F] block -> [F, B, C] f32."""
+    pl = _require_pallas()
+    S, F = rows.shape
+    C = payload.shape[-1]
+    bp = max(128, -(-num_bins // 128) * 128)
+    fp, rt = _tile_plan(bp)
+    Sp = -(-S // rt) * rt
+    Fp = -(-F // fp) * fp
+    if Sp > S:
+        rows = jnp.pad(rows, ((0, Sp - S), (0, 0)))
+        payload = jnp.pad(payload, ((0, Sp - S), (0, 0)))
+    if Fp > F:
+        # pad features' histogram rows are cropped below; their bin
+        # values are irrelevant
+        rows = jnp.pad(rows, ((0, 0), (0, Fp - F)))
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, bp=bp, fpack=fp, row_tile=rt),
+        grid=(Fp // fp, Sp // rt),
+        in_specs=[
+            pl.BlockSpec((rt, fp), lambda i, j: (j, i)),
+            pl.BlockSpec((rt, C), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((C, fp, bp), lambda i, j: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((C, Fp, bp), jnp.float32),
+        interpret=interpret,
+    )(rows, payload.astype(jnp.float32))
+    return jnp.transpose(out, (1, 2, 0))[:F, :num_bins, :]
+
+
+def hist_from_rows_pallas(rows: jnp.ndarray, payload: jnp.ndarray,
+                          num_bins: int, int_exact: bool = False,
+                          interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Histogram over a row-block matrix via the Pallas kernel.
+
+    Args:
+      rows: ``[S, F]`` integer bin matrix (row-major, u8/u16).
+      payload: ``[S, C]`` float channels, or int8 when ``int_exact``.
+      num_bins: B.
+      int_exact: accumulate an int8 payload to an EXACT int32 result
+        (subtraction-safe) via <=INT_BLOCK-row super-blocks.
+      interpret: run under the Pallas interpreter; defaults to True on
+        every non-TPU backend (the tier-1 CPU parity mode).
+
+    Returns:
+      ``[F, B, C]`` f32 (int32 when ``int_exact``).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    S = rows.shape[0]
+    if not int_exact:
+        return _hist_tiles(rows, payload, num_bins, interpret)
+    if S <= INT_BLOCK:
+        return _hist_tiles(rows, payload, num_bins,
+                           interpret).astype(jnp.int32)
+    nblk = -(-S // INT_BLOCK)
+    pad = nblk * INT_BLOCK - S
+    if pad:
+        rows = jnp.pad(rows, ((0, pad), (0, 0)))
+        payload = jnp.pad(payload, ((0, pad), (0, 0)))
+    F = rows.shape[1]
+    rows_b = rows.reshape(nblk, INT_BLOCK, F)
+    pay_b = payload.reshape(nblk, INT_BLOCK, payload.shape[-1])
+
+    def body(acc, xs):
+        r, p = xs
+        h = _hist_tiles(r, p, num_bins, interpret)
+        return acc + h.astype(jnp.int32), None
+
+    init = jnp.zeros((F, num_bins, payload.shape[-1]), jnp.int32)
+    out, _ = lax.scan(body, init, (rows_b, pay_b))
+    return out
+
+
+# standalone jitted entry point: benchmarks/hist_micro.py's pallas arm
+# and ad-hoc kernel probes dispatch through this, and registering it
+# puts the kernel under the same recompile telemetry (TPL003 /
+# obs/jit_tracker.py) as the other hot-path programs
+hist_from_rows_pallas_jit = jax.jit(
+    hist_from_rows_pallas,
+    static_argnames=("num_bins", "int_exact", "interpret"))
+
+from ..obs import register_jit  # noqa: E402  (after the jit exists)
+
+register_jit("ops/pallas_hist", hist_from_rows_pallas_jit)

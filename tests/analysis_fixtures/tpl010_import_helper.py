@@ -1,0 +1,14 @@
+# tpulint fixture: TPL010 positive — the branch lambda reaches the
+# collective through a helper IMPORTED from a sibling module (the
+# package-wide basename fallback must catch it).
+import jax.numpy as jnp
+from jax import lax
+
+from .tpl010_pos import _window_reduce
+
+
+def lambda_calls_imported_helper(pred, x, axis):
+    # EXPECT: TPL010
+    return lax.cond(pred,
+                    lambda: _window_reduce(x, axis),
+                    lambda: jnp.sum(x))

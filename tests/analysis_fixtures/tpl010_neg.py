@@ -1,0 +1,38 @@
+# tpulint fixture: TPL010 negatives — justified replicated-predicate
+# sites, collectives outside conditionals, and collective-free branches.
+# No EXPECT lines: the engine must report nothing here.
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _window_reduce(x, axis):
+    return lax.psum(jnp.sum(x), axis)
+
+
+def justified_pool_miss(slot, hists, x, axis):
+    """The ops/grow.py histogram-pool shape, with the invariant
+    named: the pragma's why documents the predicate's replication."""
+    # tpulint: replicated-cond slot derives only from the replicated tree/argmax sequence
+    return lax.cond(slot >= 0,
+                    lambda: hists[jnp.maximum(slot, 0)],
+                    lambda: _window_reduce(x, axis))
+
+
+def collective_outside_cond(pred, x, axis):
+    """Every rank joins the psum; only local work branches."""
+    g = lax.psum(x, axis)
+    return lax.cond(pred, lambda: g * 2.0, lambda: g)
+
+
+def collective_free_branches(pred, x):
+    return lax.cond(pred, lambda: jnp.sum(x), lambda: jnp.max(x))
+
+
+def _local_stat(x):
+    """Same call-shape as a collective-reaching helper, but pure."""
+    return jnp.sum(x) * 0.5
+
+
+def branch_calls_pure_helper(pred, x):
+    return lax.cond(pred, lambda: _local_stat(x), lambda: x[0])

@@ -1,0 +1,80 @@
+# tpulint fixture: TPL010 positives — device collectives inside
+# traced-conditional branches with no replicated-cond justification.
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _window_reduce(x, axis):
+    """Local helper that transitively dispatches a device collective —
+    the ops/grow.py window_hist -> hist_psum shape."""
+    return lax.psum(jnp.sum(x), axis)
+
+
+def lambda_branch_direct(pred, x, axis):
+    """Collective lexically inside a cond branch lambda."""
+    # EXPECT: TPL010
+    return lax.cond(pred,
+                    lambda: lax.psum(x, axis),
+                    lambda: x)
+
+
+def lambda_branch_through_helper(pred, x, axis):
+    """The hazard one call level down: the branch calls a local
+    function that reaches lax.psum through the call graph."""
+    # EXPECT: TPL010
+    return lax.cond(pred,
+                    lambda: _window_reduce(x, axis),
+                    lambda: jnp.sum(x))
+
+
+def _miss_branch(x, axis):
+    return _window_reduce(x, axis)
+
+
+def named_branch_function(pred, x, axis):
+    """A function reference (not a lambda) as the diverging branch."""
+    # EXPECT: TPL010
+    return lax.cond(pred, _miss_branch, jnp.sum, x, axis)
+
+
+def switch_branch(idx, x, axis):
+    """lax.switch: one arm of the branch list gathers."""
+    # EXPECT: TPL010
+    return lax.switch(idx, [lambda: jnp.sum(x),
+                            lambda: lax.pmax(jnp.max(x), axis)])
+
+
+def keyword_branch_form(pred, x, axis):
+    """Branches passed as keywords are the same hazard."""
+    # EXPECT: TPL010
+    return lax.cond(pred,
+                    true_fun=lambda: lax.psum(x, axis),
+                    false_fun=lambda: x)
+
+
+class _Pool:
+    def _miss(self, x, axis):
+        return _window_reduce(x, axis)
+
+    def attribute_branch(self, pred, x, axis):
+        """An attribute reference (bound method) as the branch."""
+        # EXPECT: TPL010
+        return lax.cond(pred, self._miss, lambda *a: a[0], x, axis)
+
+    def lambda_calls_method(self, pred, x, axis):
+        """The branch lambda reaches the collective through a METHOD
+        call — the refactor shape that must not slip past."""
+        # EXPECT: TPL010
+        return lax.cond(pred,
+                        lambda: self._miss(x, axis),
+                        lambda: x)
+
+
+def bare_pragma_does_not_suppress(pred, x, axis):
+    """A replicated-cond mark WITHOUT a why is a suppressed deadlock,
+    not an accepted invariant — still flagged."""
+    # EXPECT: TPL010
+    return lax.cond(pred,  # tpulint: replicated-cond
+                    lambda: lax.psum(x, axis),
+                    lambda: x)

@@ -49,6 +49,29 @@ def test_bench_success_emits_one_json_line():
     assert "bytes_in_use" in telem["hbm"]
 
 
+def test_probe_budget_capped_under_hostile_settings():
+    """The r04 regression class: probe retries must never outlive the
+    deadline. Even with an absurd retry budget (100 probes x 1000 s
+    timeouts) against a backend that always fails init, the probe loop
+    stops at its BENCH_DEADLINE/2 cutoff and the supervisor emits the
+    one failure line inside the deadline."""
+    t0 = time.time()
+    r = _run({"BENCH_PLATFORM": "bogus_backend",  # probe always fails
+              "BENCH_ROWS": "4000",
+              "BENCH_PROBE_RETRIES": "100",
+              "BENCH_PROBE_TIMEOUT": "1000",
+              "BENCH_PROBE_BACKOFF": "1",
+              "BENCH_DEADLINE": "90"},
+             timeout=200)
+    wall = time.time() - t0
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert wall < 90, f"probe loop outlived BENCH_DEADLINE ({wall:.0f}s)"
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln]
+    assert len(lines) == 1, r.stdout
+    rec = json.loads(lines[0])
+    assert rec["value"] is None and "error" in rec
+
+
 def test_bench_failure_emits_one_json_line_within_deadline():
     """A dead backend must still produce the one-line record, inside
     BENCH_DEADLINE, with value null and the error recorded. Forced

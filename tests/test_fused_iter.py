@@ -74,6 +74,21 @@ ELIGIBLE = [
     ("regression", {"objective": "regression", "num_leaves": 15}),
     ("monotone", {"objective": "regression", "num_leaves": 15,
                   "monotone_constraints": [1, -1] + [0] * 8}),
+    # Pallas histogram kernel inside the fused program (interpret mode
+    # on CPU): growth rides the same sibling-subtraction pipeline, so
+    # fused == eager proves the kernel composes with the one-program
+    # iteration (tests/test_pallas_hist.py owns numeric parity)
+    ("pallas_hist", {"objective": "binary", "num_leaves": 15,
+                     "hist_method": "pallas"}),
+    ("pallas_quantized", {"objective": "binary", "num_leaves": 15,
+                          "hist_method": "pallas",
+                          "use_quantized_grad": True}),
+    # depth-wise level grower fused into the one-program iteration
+    ("level_grower", {"objective": "binary", "num_leaves": 15,
+                      "max_depth": 4, "grower": "level"}),
+    ("level_pallas", {"objective": "binary", "num_leaves": 15,
+                      "max_depth": 4, "grower": "level",
+                      "hist_method": "pallas"}),
 ]
 
 

@@ -99,10 +99,13 @@ KNOWN_JITTED = {
     ("ops/gather.py", "_gather_small"),
     ("ops/grow.py", "_grow_masked_impl"),
     ("ops/grow.py", "_grow_compact_impl"),
+    ("ops/grow.py", "_grow_level_impl"),
     ("ops/grow.py", "grow_tree_impl"),
     ("ops/histogram.py", "_hist_from_rows_impl"),
     ("ops/histogram.py", "_hist_scatter"),
     ("ops/histogram.py", "build_histogram"),
+    ("ops/pallas_hist.py", "hist_from_rows_pallas"),
+    ("ops/pallas_hist.py", "_hist_tiles"),
     ("ops/predict.py", "_traverse"),
     ("ops/predict.py", "predict_leaf_binned"),
     ("ops/predict.py", "predict_leaf_raw"),
@@ -172,6 +175,15 @@ _FIXTURES = [
     "obs/tpl008_pos.py", "obs/tpl008_neg.py",
     "obs/tpl008_pragma.py",
     "tpl009_pos.py", "tpl009_neg.py",
+    "tpl010_pos.py", "tpl010_neg.py",
+]
+
+# cross-module fixture: must be linted TOGETHER with the module whose
+# helper it imports (the package-wide basename fallback resolves the
+# helper through the shared call graph)
+_FIXTURE_GROUPS = [
+    (("tpl010_import_helper.py", "tpl010_pos.py"),
+     "tpl010_import_helper.py"),
 ]
 
 
@@ -186,6 +198,20 @@ def test_rule_fixture(relpath):
         f"  expected: {expected}\n  got:      {got}\n  "
         + "\n  ".join(f"{f.fid} @ {f.lineno}: {f.message[:100]}"
                       for f in res.findings))
+
+
+@pytest.mark.parametrize("files,target",
+                         _FIXTURE_GROUPS,
+                         ids=[g[1] for g in _FIXTURE_GROUPS])
+def test_cross_module_fixture(files, target):
+    res = run_lint(root=FIXTURES, package="tpulint_fixtures",
+                   files=list(files), baseline_path="")
+    got = sorted((f.rule, f.lineno) for f in res.findings
+                 if f.relpath == target)
+    expected = _expected_findings(os.path.join(FIXTURES, target))
+    assert got == expected, (
+        f"{target}: findings diverge from # EXPECT markers\n"
+        f"  expected: {expected}\n  got:      {got}")
 
 
 def test_fixture_positive_files_have_expectations():
@@ -448,6 +474,34 @@ def test_stripping_the_watchdog_threadsafe_pragma_fails(tmp_path):
             "shared:box#1") in fids, fids
     assert ("TPL008:resilience/watchdog.py:guarded._run:"
             "shared:box#2") in fids, fids
+
+
+def test_grow_collective_conds_are_justified():
+    """The shipped tree's psum-under-cond sites (histogram-pool reads,
+    masked/forced-split gating) all carry replicated-cond whys."""
+    res = _cached_lint(("TPL010",))
+    assert not res.findings, (
+        "unjustified device collective under a traced cond:\n  "
+        + "\n  ".join(f"{f.fid} @ {f.relpath}:{f.lineno}"
+                      for f in res.findings))
+
+
+def test_stripping_the_pool_replicated_cond_pragma_fails(tmp_path):
+    """The ADVICE r4 _research_leafwise site: the pool-miss branch runs
+    window_hist -> hist_psum inside lax.cond. Without the pragma
+    documenting the replicated-predicate invariant, TPL010 must flag
+    it with the expected stable id."""
+    pragma = ("                # tpulint: replicated-cond leaf2slot is "
+              "pool state derived only from the replicated "
+              "tree/argmax sequence\n")
+    res = _lint_mutated(
+        "ops/grow.py",
+        lambda src: src.replace(pragma, ""),
+        ["TPL010"], tmp_path)
+    fids = [f.fid for f in res.findings]
+    assert ("TPL010:ops/grow.py:"
+            "_grow_compact_impl._research_leafwise.body:"
+            "cond-collective:psum#1") in fids, fids
 
 
 def test_threadsafe_pragma_requires_a_reason():

@@ -58,6 +58,46 @@ def test_two_round_matches_eager_loading(tmp_path):
                                       tb.threshold_bin)
 
 
+def test_two_round_name_label_column_resolves_header(tmp_path):
+    """A name-based label_column must resolve against the header in
+    the two-round path itself (ADVICE r4: it used to silently train on
+    column 0 as the label). The label here is the LAST column, so any
+    column-0 fallback flips every label and the eager/two-round parity
+    below fails loudly."""
+    path = str(tmp_path / "train_named.csv")
+    rs = np.random.RandomState(5)
+    X = rs.randn(1500, 4)
+    y = ((X @ rs.randn(4)) > 0).astype(float)
+    cols = np.column_stack([X, y])          # label LAST
+    with open(path, "w") as fh:
+        fh.write("f0,f1,f2,f3,target\n")
+        np.savetxt(fh, cols, delimiter=",", fmt="%.6g")
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "max_bin": 31, "header": True,
+              "label_column": "name:target",
+              "bin_construct_sample_cnt": 10_000}
+    d_two = lgb.Dataset(path, params=dict(params, two_round=True))
+    d_two.construct()
+    np.testing.assert_allclose(np.asarray(d_two.get_label()), y)
+    d_eager = lgb.Dataset(path, params=dict(params))
+    d_eager.construct()
+    np.testing.assert_array_equal(d_eager.host_bins(),
+                                  d_two.host_bins())
+
+
+def test_two_round_name_label_without_header_raises(tmp_path):
+    """name:... without header=true cannot be resolved — the loader
+    must refuse, never assume column 0."""
+    from lightgbm_tpu.basic import LightGBMError
+    path = str(tmp_path / "noheader.csv")
+    _write_csv(path, 200, 3, seed=6)
+    with pytest.raises(LightGBMError, match="header"):
+        ds = lgb.Dataset(path, params={
+            "two_round": True, "label_column": "name:target",
+            "verbosity": -1})
+        ds.construct()
+
+
 def test_two_round_sampled_mappers_close(tmp_path):
     """With a sub-full sample the mappers come from the sample only
     (reference semantics); training must still work well."""
