@@ -181,11 +181,18 @@ def _emit_failure(err):
 # features w/ NaN, docs/Experiments.rst:121 Allstate shape; reference
 # trains 13.2M rows in 148.231 s / 500 iters = 3.373 iters/sec). The
 # full 13.2M x 4228 float32 matrix is ~223 GB — beyond host RAM — so
-# the preset defaults to 2M rows and reports vs_baseline through the
-# same linear-in-rows rescale the Higgs preset uses.
+# the eager preset defaults to 2M rows; BENCH_STREAMING=1 (or a
+# --streaming argv flag) instead ingests through the chunked two-pass
+# pipeline (lightgbm_tpu/data/, docs/DATA.md), where peak host RSS is
+# the BINNED matrix plus one generator chunk — the full-scale
+# 13.2M-row shape becomes constructible on an ordinary host.
 # Default preset: the REAL Higgs shape — measured, not extrapolated.
 PRESET = os.environ.get("BENCH_PRESET", "higgs")
 _ALLSTATE = PRESET == "allstate"
+_STREAMING = (os.environ.get("BENCH_STREAMING", "") == "1"
+              or "--streaming" in sys.argv)
+# rows per ingest chunk in streaming mode (the peak-RSS knob)
+INGEST_CHUNK = int(os.environ.get("BENCH_INGEST_CHUNK", 262_144))
 ALLSTATE_ROWS = 13_184_290
 ALLSTATE_BASELINE_ITERS_PER_SEC = 500.0 / 148.231
 N_ROWS = int(os.environ.get(
@@ -217,33 +224,81 @@ def make_higgs_like(n, f, seed=0):
     return X, y.astype(np.float64)
 
 
-def make_allstate_like(n, f, seed=0, per_group=128):
-    """Wide sparse one-hot blocks + NaN (the Allstate/Bosch shape EFB
-    exists for): f features in blocks of ``per_group``, one nonzero
-    per row per block, ~10% of rows NaN-ified in feature 0. The
-    [n, f] float32 matrix is this function's single big allocation
-    (n*f*4 bytes); main() calls it twice (train + valid), so peak host
-    RSS is (BENCH_ROWS + BENCH_VALID) * BENCH_FEATURES * 4 bytes —
-    ~44 GB at the default 2M-row preset — and must stay well under
-    host RAM."""
-    rs = np.random.RandomState(seed)
+def higgs_chunks(n, f, seed=0, chunk_rows=None):
+    """Chunked Higgs-shaped generator for --streaming mode. Each chunk
+    is drawn from a per-chunk RandomState (seeded by start row), so
+    pass 1 and pass 2 of the ingest pipeline see identical data
+    without the generator ever holding more than one chunk. NOTE: the
+    row stream differs from make_higgs_like's single-stream layout, so
+    streaming runs carry no ``auc_ref`` oracle."""
+    chunk_rows = chunk_rows or INGEST_CHUNK
+    coef = np.random.RandomState(987).randn(f).astype(np.float32)
+    start = 0
+    while start < n:
+        c = min(chunk_rows, n - start)
+        rs = np.random.RandomState(
+            (seed * 1_000_003 + start) % (2 ** 31 - 1))
+        X = rs.randn(c, f).astype(np.float32)
+        logits = X @ coef * 0.5 + 0.5 * rs.randn(c).astype(np.float32)
+        yield X, (logits > 0).astype(np.float64)
+        start += c
+
+
+def allstate_chunks(n, f, seed=0, per_group=128, chunk_rows=None):
+    """Chunked Allstate-shaped generator: wide sparse one-hot blocks +
+    NaN (the shape EFB exists for), emitted ``chunk_rows`` rows at a
+    time so no [n, f] matrix is ever held. Values per position come
+    from a FIXED stream (seed 12345) so train (seed=0) and valid
+    (seed=1) sample the same underlying task; per-chunk RandomStates
+    keyed on the start row make the stream re-iterable for the
+    two-pass ingest. Labels threshold the signal at its expectation
+    (``groups``; vals ~ U(0,2)) instead of the global median, which a
+    chunked generator cannot know."""
+    chunk_rows = chunk_rows or INGEST_CHUNK
     groups = f // per_group
-    X = np.zeros((n, f), np.float32)
-    signal = np.zeros(n, np.float32)
-    # the task definition (per-position values = the signal function)
-    # comes from a FIXED stream so train (seed=0) and valid (seed=1)
-    # sample the same underlying task; only row draws vary with seed
     vals = np.random.RandomState(12345).rand(
         groups, per_group).astype(np.float32) * 2
-    rows = np.arange(n)
-    for g in range(groups):
-        pick = rs.randint(0, per_group, n)
-        X[rows, g * per_group + pick] = vals[g, pick]
-        signal += vals[g, pick]
-    nanmask = rs.rand(n) < 0.1
-    X[nanmask, 0] = np.nan
-    y = (signal > np.median(signal)).astype(np.float32)
-    return X, y.astype(np.float64)
+    thresh = np.float32(groups)  # E[signal] = groups * E[U(0,2)]
+    start = 0
+    while start < n:
+        c = min(chunk_rows, n - start)
+        rs = np.random.RandomState(
+            (seed * 1_000_003 + start) % (2 ** 31 - 1))
+        X = np.zeros((c, f), np.float32)
+        signal = np.zeros(c, np.float32)
+        rows = np.arange(c)
+        for g in range(groups):
+            pick = rs.randint(0, per_group, c)
+            X[rows, g * per_group + pick] = vals[g, pick]
+            signal += vals[g, pick]
+        X[rs.rand(c) < 0.1, 0] = np.nan
+        yield X, (signal > thresh).astype(np.float64)
+        start += c
+
+
+def make_allstate_like(n, f, seed=0, per_group=128):
+    """Eager wrapper over :func:`allstate_chunks`: fills ONE
+    preallocated [n, f] float32 matrix chunk by chunk (transient
+    overhead = one chunk, no float64 copy anywhere — the old
+    whole-matrix construction loop plus label astype is gone,
+    ADVICE.md medium). Peak host RSS across main() is
+    (BENCH_ROWS + BENCH_VALID) * BENCH_FEATURES * 4 bytes; the
+    --streaming mode drops even that by never materializing X."""
+    X = np.empty((n, f), np.float32)
+    y = np.empty(n, np.float64)
+    row = 0
+    for Xc, yc in allstate_chunks(n, f, seed=seed, per_group=per_group):
+        X[row:row + len(yc)] = Xc
+        y[row:row + len(yc)] = yc
+        row += len(yc)
+    return X, y
+
+
+def _peak_rss_bytes():
+    """Linux ru_maxrss is KiB; the one number the streaming-ingest
+    memory claim is checked against."""
+    import resource
+    return int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss) * 1024
 
 
 def auc(y, p):
@@ -285,13 +340,38 @@ def main():
     _PhaseTimer.enable()
     recompile_watch = lgb_obs.RecompileWatcher()
 
-    if _ALLSTATE:
+    valid_chunks = None
+    Xv = yv = None
+    if _STREAMING:
+        # chunked two-pass ingestion (lightgbm_tpu/data/): the dense
+        # float train matrix never exists; the valid set is predicted
+        # chunk-by-chunk below, so it is never materialized either
+        from lightgbm_tpu.data import GeneratorChunkSource
+        gen = allstate_chunks if _ALLSTATE else higgs_chunks
+
+        def train_chunks():
+            return gen(N_ROWS, N_FEATURES, seed=0,
+                       chunk_rows=INGEST_CHUNK)
+
+        def valid_chunks():
+            return gen(N_VALID, N_FEATURES, seed=1,
+                       chunk_rows=INGEST_CHUNK)
+
+        src = GeneratorChunkSource(train_chunks, num_rows=N_ROWS,
+                                   num_features=N_FEATURES)
+        ds = lgb.Dataset(src, params={"max_bin": MAX_BIN,
+                                      "ingest_chunk_rows": INGEST_CHUNK})
+        ds.construct()
+    elif _ALLSTATE:
         # train/valid generated separately so peak host RSS is
         # (N_ROWS + N_VALID)·f·4 bytes — the slice-copy pattern below
         # would transiently hold ~2.6x that (X + Xtr + Xv), ~89 GB at
         # the default preset
         Xtr, ytr = make_allstate_like(N_ROWS, N_FEATURES, seed=0)
         Xv, yv = make_allstate_like(N_VALID, N_FEATURES, seed=1)
+        ds = lgb.Dataset(Xtr, label=ytr, params={"max_bin": MAX_BIN})
+        ds.construct()
+        del Xtr
     else:
         # single generation + split: this exact layout is what
         # ORACLE_AUC was measured against — don't change it
@@ -300,9 +380,9 @@ def main():
         Xv, yv = X[N_ROWS:].copy(), y[N_ROWS:].copy()
         Xtr, ytr = X[:N_ROWS].copy(), y[:N_ROWS]
         del X
-    ds = lgb.Dataset(Xtr, label=ytr, params={"max_bin": MAX_BIN})
-    ds.construct()
-    del Xtr
+        ds = lgb.Dataset(Xtr, label=ytr, params={"max_bin": MAX_BIN})
+        ds.construct()
+        del Xtr
 
     bst = lgb.Booster(
         params={
@@ -330,7 +410,17 @@ def main():
     if AUC_ITERS > trained:
         for _ in range(AUC_ITERS - trained):
             bst._engine.train_one_iter()
-        result_auc = float(auc(yv, bst.predict(Xv)))
+        if _STREAMING:
+            # valid set predicted chunk-by-chunk: only predictions and
+            # labels (8 bytes/row each) are ever held, never the rows
+            preds, labels = [], []
+            for Xc, yc in valid_chunks():
+                preds.append(bst.predict(Xc))
+                labels.append(yc)
+            result_auc = float(auc(np.concatenate(labels),
+                                   np.concatenate(preds)))
+        else:
+            result_auc = float(auc(yv, bst.predict(Xv)))
 
     iters_per_sec = ITERS / dt
     # linear rescale to the preset's full row count (histogram work is
@@ -347,11 +437,15 @@ def main():
         "metric": f"boosting iters/sec, {shape_name} "
                   f"{N_ROWS}x{N_FEATURES}"
                   f"{scale_note}, {NUM_LEAVES} leaves, "
-                  f"{MAX_BIN} bins, backend={jax.default_backend()}",
+                  f"{MAX_BIN} bins, backend={jax.default_backend()}"
+                  + (", streaming-ingest" if _STREAMING else ""),
         "value": round(iters_per_sec_full, 4),
         "unit": "iters/sec",
         "vs_baseline": round(iters_per_sec_full / base, 4),
+        "peak_rss_bytes": _peak_rss_bytes(),
     }
+    if _STREAMING:
+        result["ingest"] = dict(ds._ingest_stats)
     if bst._engine.bundle is not None:
         b = bst._engine.bundle
         result["efb_bundles"] = len(b.groups)
@@ -368,7 +462,10 @@ def main():
     }
     if result_auc is not None:
         result["auc"] = round(result_auc, 6)
-        oracle_config = (N_FEATURES == 28 and NUM_LEAVES == 255
+        # the oracle was measured against the exact eager single-stream
+        # layout; streaming draws a different (per-chunk-seeded) stream
+        oracle_config = (not _STREAMING and N_FEATURES == 28
+                         and NUM_LEAVES == 255
                          and MAX_BIN == 255 and N_VALID == 524_288
                          and AUC_ITERS == 50)
         if oracle_config and N_ROWS in ORACLE_AUC:

@@ -51,7 +51,7 @@ __all__ = [
 # eager __init__ used to bind these as an import side effect
 _SUBMODULES = {
     "analysis", "basic", "callback", "cli", "config", "convert",
-    "engine", "metrics", "models", "objectives", "obs", "ops",
+    "data", "engine", "metrics", "models", "objectives", "obs", "ops",
     "parallel", "plotting", "prediction", "ranking", "resilience",
     "shap", "sklearn", "utils",
 }

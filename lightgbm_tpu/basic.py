@@ -328,19 +328,6 @@ class Sequence:
         raise NotImplementedError("Sequence.__len__")
 
 
-def _materialize_sequences(seqs) -> np.ndarray:
-    """Pull all rows from chunked Sequence sources into one matrix."""
-    parts = []
-    for s in seqs:
-        n = len(s)
-        bs = max(1, int(getattr(s, "batch_size", 4096) or 4096))
-        chunks = [np.atleast_2d(np.asarray(s[i:min(i + bs, n)],
-                                           dtype=np.float64))
-                  for i in range(0, n, bs)]
-        parts.append(np.vstack(chunks))
-    return np.vstack(parts)
-
-
 def _extract_arrow(data):
     """pyarrow Table / RecordBatch -> [n, F] float64 + column names
     (the reference's Arrow C-data-interface ingest, arrow.h)."""
@@ -600,6 +587,15 @@ class Dataset:
         feature_name = self.feature_name
         if isinstance(data, (str, Path)) and self._is_binary_file(str(data)):
             return self._construct_from_binary(str(data))
+        # out-of-core streaming construct (lightgbm_tpu/data/): chunk
+        # sources always stream; text/parquet paths stream when
+        # ingest_chunk_rows > 0 (docs/DATA.md). The dense float matrix
+        # never exists on this path.
+        from .data.sources import coerce_chunk_source
+        chunk_src = coerce_chunk_source(data, cfg)
+        if chunk_src is not None:
+            return self._construct_streaming(cfg, chunk_src, label,
+                                             weight, group)
         if isinstance(data, (str, Path)):
             if cfg.two_round and self.reference is None:
                 cat_set = set()
@@ -658,11 +654,6 @@ class Dataset:
                 X, names = _extract_arrow(data)
                 if feature_name == "auto" and names:
                     feature_name = names
-            elif isinstance(data, Sequence):
-                X = _materialize_sequences([data])
-            elif isinstance(data, (list, tuple)) and data \
-                    and all(isinstance(s, Sequence) for s in data):
-                X = _materialize_sequences(list(data))
             elif hasattr(data, "tocsr") or hasattr(data, "toarray"):
                 X = np.asarray(data.todense(), dtype=np.float64)
             elif isinstance(data, np.ndarray):
@@ -775,6 +766,108 @@ class Dataset:
             self.data = None
         return self
 
+    def _resolve_streaming_cats(self, cfg, src) -> set:
+        """Categorical-feature resolution for chunk sources: integer
+        indices always work; names resolve through the source's column
+        names (CSV header, Arrow schema) when it has any. Precedence
+        matches the eager constructor: the ``categorical_feature``
+        argument wins outright, the params spec is only a fallback
+        when the argument resolved to nothing."""
+        cat_set = set()
+        names = src.feature_names()
+        for spec in (self.categorical_feature, cfg.categorical_feature):
+            if cat_set:
+                break
+            if spec in ("auto", "", None):
+                continue
+            if isinstance(spec, str):
+                spec = [c for c in spec.split(",") if c]
+            for c in spec or []:
+                try:
+                    cat_set.add(int(c))
+                    continue
+                except (TypeError, ValueError):
+                    pass
+                if names and str(c) in names:
+                    cat_set.add(names.index(str(c)))
+                else:
+                    raise LightGBMError(
+                        f"categorical feature {c!r} cannot be resolved "
+                        "for a chunked source without column names; "
+                        "pass integer indices (or a header/Arrow "
+                        "schema)")
+        return cat_set
+
+    def _construct_streaming(self, cfg, src, label, weight,
+                             group) -> "Dataset":
+        """Out-of-core construct (lightgbm_tpu/data/, docs/DATA.md):
+        two-pass chunk ingestion — sample -> host-synced BinMappers ->
+        chunk-by-chunk binning into the preallocated shard. The dense
+        float matrix never exists; peak host memory scales with
+        ``ingest_chunk_rows x n_features``, not dataset rows."""
+        from .data.ingest import dataset_digest, ingest_dataset
+        cat_set = self._resolve_streaming_cats(cfg, src)
+        ref = None
+        if self.reference is not None:
+            ref = self.reference.construct()
+        # linear trees fit on raw numerical values: pass 2 retains the
+        # used-column f32 matrix — the eager path's exact retention
+        # cost — instead of refusing the mode (valid sets inherit the
+        # reference's retention so they can be scored)
+        keep_raw = bool(cfg.linear_tree) or (
+            ref is not None and ref.raw_numeric() is not None)
+        res = ingest_dataset(src, cfg, cat_set, reference=ref,
+                             keep_raw=keep_raw)
+        y = res.label
+        if label is not None:
+            y = np.asarray(label, np.float64).ravel()
+        if y is None:
+            raise LightGBMError("Label should not be None")
+        if len(y) != res.n:
+            raise LightGBMError(
+                f"Length of label ({len(y)}) != number of rows "
+                f"({res.n})")
+        if weight is None and res.weight is not None:
+            weight = res.weight
+        # companion metadata files of a streamed text path (Metadata::
+        # Init semantics, like the eager and two-round loaders)
+        path = getattr(src, "path", None)
+        if path is not None:
+            if weight is None and os.path.exists(path + ".weight"):
+                weight = np.loadtxt(path + ".weight")
+            if group is None and os.path.exists(path + ".query"):
+                group = np.loadtxt(path + ".query").astype(np.int64)
+        self._n, self._F_total = res.n, res.F
+        fn = self.feature_name
+        names = src.feature_names()
+        if ref is not None:
+            self._feature_names = list(ref._feature_names)
+            self._cat_idx = set(ref._cat_idx)
+        else:
+            if isinstance(fn, list) and len(fn) == res.F:
+                self._feature_names = list(fn)
+            elif names and len(names) == res.F:
+                self._feature_names = [str(c) for c in names]
+            else:
+                self._feature_names = [f"Column_{i}"
+                                       for i in range(res.F)]
+            self._cat_idx = set(cat_set)
+        self.mappers = res.mappers
+        self._used_features = res.used
+        self._full_mappers = res.full_mappers
+        self._bins = res.bins
+        self._F = len(res.mappers)
+        self._raw_numeric = res.raw
+        # checkpoint data fingerprint: accumulated incrementally over
+        # the pass-2 label/bin chunks; only an explicit label override
+        # forces a recompute of the label leg
+        if label is not None or res.digest is None:
+            self._data_digest = dataset_digest(y, res.bins)
+        else:
+            self._data_digest = res.digest
+        self._ingest_stats = res.stats
+        return self._install_metadata(y, weight, group, res.n)
+
     def _finish_two_round(self, cfg, out, label, weight, group,
                           cat_set) -> "Dataset":
         """Install the out-of-core loader's pre-binned result (the tail
@@ -886,6 +979,10 @@ class Dataset:
 
     def set_label(self, label) -> "Dataset":
         self.label = np.asarray(label, np.float64).ravel()
+        # a streaming construct's precomputed checkpoint fingerprint
+        # covered the OLD labels; drop it so the checkpoint layer
+        # rehashes the current ones (different-data refusal stays sound)
+        self._data_digest = None
         return self
 
     def set_weight(self, weight) -> "Dataset":

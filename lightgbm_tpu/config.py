@@ -429,6 +429,16 @@ class Config:
     feature_pre_filter: bool = True
     pre_partition: bool = False
     two_round: bool = False
+    # out-of-core streaming ingestion (lightgbm_tpu/data/, docs/DATA.md):
+    # rows per ingest chunk for the two-pass construct. 0 (default)
+    # keeps in-memory inputs eager; chunked sources (RowChunkSource /
+    # Sequence / generator factories) always stream and use this as
+    # their chunk size when set. > 0 additionally streams CSV/TSV and
+    # parquet paths chunk-by-chunk, so the dense float matrix never
+    # exists and peak host memory scales with ingest_chunk_rows x
+    # n_features (plus the bin_construct_sample_cnt sample), not with
+    # dataset rows
+    ingest_chunk_rows: int = 0
     header: bool = False
     label_column: str = ""
     weight_column: str = ""
@@ -550,6 +560,7 @@ class Config:
         "max_bin": (2, None),
         "min_data_in_bin": (1, None),
         "bin_construct_sample_cnt": (1, None),
+        "ingest_chunk_rows": (0, None),
         "min_data_in_leaf": (0, None),
         "min_sum_hessian_in_leaf": (0.0, None),
         "bagging_fraction": (0.0, 1.0, "gt"),

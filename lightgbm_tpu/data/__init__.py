@@ -1,0 +1,29 @@
+"""Out-of-core streaming ingestion + sharded binning (docs/DATA.md).
+
+``Dataset(chunked_source | path, params={"ingest_chunk_rows": N})``
+constructs training data without the dense float matrix ever existing:
+:mod:`~lightgbm_tpu.data.sources` defines the re-iterable
+:class:`RowChunkSource` protocol and its adapters (numpy array,
+generator factory, ``Sequence``, CSV/TSV, import-guarded
+Arrow/parquet); :mod:`~lightgbm_tpu.data.ingest` runs the two-pass
+pipeline — sample -> BinMappers (host-synced under the collective
+watchdog in multi-process worlds) -> chunk-by-chunk binning into the
+preallocated per-host shard.
+
+Host-side numpy only; importing this package never imports jax.
+"""
+
+from .ingest import (INGEST_FAULT_ITERATION, IngestResult,
+                     dataset_digest, ingest_dataset)
+from .sources import (DEFAULT_CHUNK_ROWS, ArrayChunkSource,
+                      ArrowChunkSource, CSVChunkSource,
+                      GeneratorChunkSource, RowChunk, RowChunkSource,
+                      SequenceChunkSource, coerce_chunk_source)
+
+__all__ = [
+    "RowChunk", "RowChunkSource", "ArrayChunkSource",
+    "GeneratorChunkSource", "SequenceChunkSource", "CSVChunkSource",
+    "ArrowChunkSource", "coerce_chunk_source", "DEFAULT_CHUNK_ROWS",
+    "ingest_dataset", "IngestResult", "dataset_digest",
+    "INGEST_FAULT_ITERATION",
+]

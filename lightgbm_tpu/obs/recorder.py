@@ -75,6 +75,10 @@ class TelemetryRecorder:
                 engines.append(eng)
         self._engines = engines
         if self._started:
+            # reused recorder (second train() call): the file is
+            # already open, so a fresh streaming dataset's ingest
+            # event can be recorded right away
+            self._record_ingest()
             return
         from ..utils.timer import Timer
         self._prev_timer_enabled = Timer.enabled()
@@ -101,6 +105,26 @@ class TelemetryRecorder:
                 log_warning(f"telemetry: cannot open {self.path!r} "
                             f"({e}); events will not be written")
                 self._file = None
+        self._record_ingest()
+
+    def _record_ingest(self) -> None:
+        """One ``{"event": "ingest"}`` line per streamed training set
+        (lightgbm_tpu/data/): construction ran before the recorder
+        attached, so its phase times would otherwise be invisible to
+        the per-iteration deltas. Recorded at most once per Dataset —
+        a recorder reused across train() calls must not repeat it."""
+        if self._file is None:
+            # nothing can be written (non-writer rank, or degraded
+            # no-file mode): leave the dataset unmarked so a later
+            # healthy recorder still gets to record the event
+            return
+        for eng in self._engines:
+            ts = getattr(eng, "train_set", None)
+            stats = getattr(ts, "_ingest_stats", None)
+            if stats is None or getattr(ts, "_ingest_recorded", False):
+                continue
+            ts._ingest_recorded = True
+            self._write_line({"event": "ingest", **stats})
 
     def close(self) -> None:
         """Flush and restore the Timer to its pre-attach state. Fault
@@ -318,6 +342,7 @@ def summarize_events(path: str) -> dict:
     wall = 0.0
     last_eval: Dict[str, float] = {}
     faults: Dict[str, int] = {}
+    ingest: Optional[Dict[str, float]] = None
 
     def _parse(line: str, is_last: bool) -> Optional[dict]:
         try:
@@ -343,6 +368,9 @@ def summarize_events(path: str) -> dict:
         if ev.get("event") == "fault":
             kind = str(ev.get("kind", "unknown"))
             faults[kind] = faults.get(kind, 0) + 1
+            continue
+        if ev.get("event") == "ingest":
+            ingest = {k: v for k, v in ev.items() if k != "event"}
             continue
         if ev.get("event") != "iteration":
             continue
@@ -375,7 +403,7 @@ def summarize_events(path: str) -> dict:
     return {"iterations": iters, "wall_time": wall, "phases": phases,
             "recompiles": recompiles, "peak_hbm_bytes": peak_hbm,
             "total_leaves": leaves, "total_split_gain": gain,
-            "last_eval": last_eval, "faults": faults}
+            "last_eval": last_eval, "faults": faults, "ingest": ingest}
 
 
 def render_stats_table(summary: dict) -> str:
@@ -387,6 +415,14 @@ def render_stats_table(summary: dict) -> str:
     hbm = summary["peak_hbm_bytes"]
     lines.append("peak HBM             : " +
                  (f"{hbm / 2**20:.1f} MiB" if hbm is not None else "n/a"))
+    ing = summary.get("ingest")
+    if ing:
+        lines.append(
+            f"ingest               : {ing.get('rows', 0)} rows / "
+            f"{ing.get('chunks', 0)} chunks of "
+            f"{ing.get('chunk_rows', 0)} "
+            f"(pass1 {ing.get('pass1_s', 0.0):.3f} s, "
+            f"pass2 {ing.get('pass2_s', 0.0):.3f} s)")
     lines.append(f"leaves grown         : {summary['total_leaves']}")
     lines.append(f"split gain sum       : {summary['total_split_gain']:g}")
     faults = summary.get("faults") or {}

@@ -145,6 +145,13 @@ def distributed_dataset(X, label=None, params: Optional[dict] = None,
     Shards must have equal row counts across processes (pad the last
     shard if needed; padded rows can carry weight 0). For ranking,
     each shard must contain whole query groups.
+
+    ``X`` may also be a chunked source (``data.RowChunkSource`` /
+    ``Sequence`` / generator factory) holding THIS process's shard:
+    the streaming construct already synchronized the bin mappers
+    across ranks during its pass 1 and binned pass 2 against them
+    (data/ingest.py), so only the binned-shard allgather remains — the
+    dense float shard never exists on any host (docs/DATA.md).
     """
     from ..basic import Dataset
 
@@ -171,11 +178,28 @@ def distributed_dataset(X, label=None, params: Optional[dict] = None,
             "distributed_dataset requires equal row counts per process "
             f"(pad the last shard with weight-0 rows); got {detail}")
 
-    ds.mappers = sync_bin_mappers(ds.mappers)
-    # re-bin the local rows against the synchronized boundaries
-    Xf = np.asarray(X, np.float64)
-    cols = [Xf[:, j] for j in ds._used_features]
-    local_bins = bin_values(cols, ds.mappers)
+    if getattr(ds, "_ingest_stats", None) is not None:
+        # streaming construct: mappers were synced between its two
+        # passes, so the shard is already binned against the global
+        # boundaries — and there is no raw matrix to re-bin anyway
+        local_bins = ds._bins
+    else:
+        # sync the FULL per-feature mapper list, not the used subset:
+        # a feature trivial on this shard but not on rank 0's means the
+        # per-rank used-feature selections differ, and binning against
+        # a mismatched mapper list silently pairs columns with the
+        # wrong boundaries before the shard allgather diverges/hangs.
+        # Deriving used from the synced list makes every rank agree.
+        ds._full_mappers = sync_bin_mappers(ds._full_mappers)
+        used = [j for j, m in enumerate(ds._full_mappers)
+                if not m.is_trivial]
+        ds._used_features = np.asarray(used, np.int32)
+        ds.mappers = [ds._full_mappers[j] for j in used]
+        ds._F = len(ds.mappers)
+        # re-bin the local rows against the synchronized boundaries
+        Xf = np.asarray(X, np.float64)
+        cols = [Xf[:, j] for j in ds._used_features]
+        local_bins = bin_values(cols, ds.mappers)
 
     def gather_rows(a, dtype, what="rows"):
         if a is None:
@@ -205,4 +229,8 @@ def distributed_dataset(X, label=None, params: Optional[dict] = None,
     # errors instead of silently pairing half a matrix with global
     # labels)
     ds.data = None
+    # a streaming construct's fingerprint covers the LOCAL shard; the
+    # Dataset is global now, so drop it — the checkpoint layer recomputes
+    # from the gathered label/bins (resilience/checkpoint.py)
+    ds._data_digest = None
     return ds

@@ -157,19 +157,23 @@ def _dataset_fingerprint(eng) -> Dict[str, Any]:
     rows. Guards the hands-off env-var mode, where a still-exported
     ``LIGHTGBM_TPU_CHECKPOINT`` plus a second experiment on different
     data of the same shape would otherwise silently continue the first
-    run's trees. Hashed once per engine (the data is immutable during
-    training), so per-snapshot cost is a dict lookup."""
+    run's trees. A streaming construct (lightgbm_tpu/data/) accumulated
+    the identical digest incrementally over its pass-2 label/bin chunks
+    (``data.ingest.dataset_digest``), so resume works across ingestion
+    modes — and still refuses different data. Hashed once per engine
+    (the data is immutable during training), so per-snapshot cost is a
+    dict lookup."""
     cached = getattr(eng, "_ckpt_data_fp", None)
     if cached is not None:
         return cached
-    import hashlib
-    h = hashlib.sha256()
-    h.update(np.ascontiguousarray(
-        np.asarray(eng.train_set.get_label(), np.float64)).tobytes())
-    h.update(np.ascontiguousarray(
-        eng.train_set.host_bins()[:64]).tobytes())
+    digest = getattr(eng.train_set, "_data_digest", None)
+    if digest is None:
+        from ..data.ingest import dataset_digest
+        digest = dataset_digest(
+            np.asarray(eng.train_set.get_label(), np.float64),
+            eng.train_set.host_bins())
     fp = {"n": int(eng.n), "F": int(eng.F), "K": int(eng.K),
-          "digest": h.hexdigest()}
+          "digest": digest}
     eng._ckpt_data_fp = fp
     return fp
 

@@ -29,7 +29,10 @@ the launch supervisor, so these are additionally gated on
 - ``rank_kill@N`` — ``SIGKILL`` the selected rank at the start of
   iteration ``N``; the *surviving* ranks then hang in their next host
   collective, which the watchdog (resilience/watchdog.py) converts
-  into a ``LightGBMError`` within its deadline.
+  into a ``LightGBMError`` within its deadline. ``N = -1`` fires
+  during streaming ingestion instead: right before the pass-1
+  bin-mapper sync (``data.ingest.INGEST_FAULT_ITERATION``), so the
+  survivors abort naming ``spmd/sync_bin_mappers``.
 - ``stall_rank@N`` — the selected rank sleeps forever at the start of
   iteration ``N`` (the straggler / swap-storm failure mode: the
   process is alive, so no transport error ever surfaces — only the

@@ -1,0 +1,35 @@
+# tpulint fixture: TPL007 negative — the REAL ingestion idioms
+# (lightgbm_tpu/data/ingest.py, parallel/spmd.py) must stay clean:
+# world-size gates are rank-invariant, and a rank-dependent ARGUMENT
+# to a collective every rank joins is fine. No EXPECT lines.
+import json
+
+import jax
+
+from lightgbm_tpu.parallel.hostsync import (host_allgather,
+                                            host_broadcast_bytes)
+
+
+def pass1_mapper_sync(mappers):
+    """The pipeline's pass-1 shape: sync only when a world exists
+    (process_count is rank-invariant), with rank 0 supplying the
+    payload every rank receives."""
+    if jax.process_count() <= 1:
+        return mappers
+    payload = None
+    if jax.process_index() == 0:
+        payload = json.dumps(mappers).encode()
+    return json.loads(host_broadcast_bytes(
+        payload, "spmd/sync_bin_mappers").decode())
+
+
+def pass2_shard_gather(local_bins):
+    """The pass-2 tail: every rank contributes its binned shard once;
+    rank-gated work AFTER the collective (rank-0-only writes) is the
+    idiom, not a hazard."""
+    if jax.process_count() <= 1:
+        return local_bins[None]
+    g = host_allgather(local_bins, "spmd/dataset_bins")
+    if jax.process_index() == 0:
+        return g
+    return g

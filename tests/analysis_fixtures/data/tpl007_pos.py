@@ -1,0 +1,38 @@
+# tpulint fixture: TPL007 positive — ingestion-pipeline shapes. The
+# two-pass streaming construct (lightgbm_tpu/data/ingest.py) runs host
+# collectives between its passes (bin-mapper sync) and around shard
+# gathers; rank-divergent reach of any of them deadlocks the world.
+# An `# EXPECT: <RULE>` comment pins a finding on the following line.
+import jax
+
+from lightgbm_tpu.parallel.hostsync import host_allgather
+from lightgbm_tpu.parallel.spmd import sync_bin_mappers
+
+
+def pass1_sync_only_on_rank0(mappers):
+    """Pass-1 mapper sync gated on the rank: every other rank skips
+    the broadcast it is supposed to join."""
+    if jax.process_index() == 0:
+        # EXPECT: TPL007
+        mappers = sync_bin_mappers(mappers)
+    return mappers
+
+
+def pass2_gather_in_recovery(shard):
+    """Retrying the binned-shard gather from an except handler: only
+    ranks that hit the error re-join."""
+    try:
+        out = host_allgather(shard, "ok/ingest_bins")
+    except RuntimeError:
+        # EXPECT: TPL007
+        out = host_allgather(shard, "bad/ingest_bins_retry")
+    return out
+
+
+def per_rank_chunk_count_gathers(chunks):
+    """Gathering once per LOCAL chunk: ranks with different chunk
+    counts join a different number of collectives."""
+    me = jax.process_index()
+    for _ in range(me):
+        # EXPECT: TPL007
+        host_allgather(chunks, "bad/per_chunk")

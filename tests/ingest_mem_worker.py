@@ -1,0 +1,85 @@
+"""Memory-budget proof for streaming ingestion (run as a subprocess by
+tests/test_data_ingest.py::test_peak_rss_bounded_by_chunk_footprint...).
+
+Constructs a Dataset from a generator source whose total size is >= 10x
+the chunk size, with NO jax import anywhere (the data/ path is
+jax-lazy), and reports ru_maxrss deltas as one JSON line:
+
+- ``delta_mb``   — peak-RSS growth across the construct
+- ``raw_mb``     — what the dense float64 matrix alone would cost
+- ``budget_mb``  — binned product + sample + label + chunk slack
+
+The assertion (made by the test) is delta < raw/2 and delta < budget:
+peak memory scales with the chunk footprint and the binned product,
+never with the raw dataset.
+"""
+
+import json
+import os
+import resource
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+from lightgbm_tpu.basic import Dataset
+from lightgbm_tpu.data import GeneratorChunkSource
+
+N = 1 << 20          # 1,048,576 rows
+F = 64
+CHUNK = 16384        # 64 chunks: dataset is 64x the chunk size
+SAMPLE = 20000
+
+
+def chunks():
+    start = 0
+    while start < N:
+        c = min(CHUNK, N - start)
+        rs = np.random.RandomState(start % (2 ** 31 - 1))
+        X = rs.randn(c, F).astype(np.float32)
+        y = (X[:, 0] > 0).astype(np.float64)
+        yield X, y
+        start += c
+
+
+def rss_mb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main():
+    # warm numpy + the generator once so the baseline includes every
+    # fixed cost (interpreter, numpy pools, one chunk buffer)
+    for Xc, yc in chunks():
+        del Xc, yc
+        break
+    base = rss_mb()
+
+    src = GeneratorChunkSource(chunks, num_rows=N, num_features=F)
+    ds = Dataset(src, params={"verbosity": -1, "max_bin": 63,
+                              "bin_construct_sample_cnt": SAMPLE,
+                              "ingest_chunk_rows": CHUNK})
+    ds.construct()
+    assert ds.num_data() == N
+    delta = rss_mb() - base
+
+    bins_mb = ds.host_bins().nbytes / 2 ** 20
+    raw_mb = N * F * 8 / 2 ** 20                      # float64 matrix
+    sample_mb = SAMPLE * F * 8 / 2 ** 20
+    label_mb = N * 8 / 2 ** 20
+    chunk_mb = CHUNK * F * 8 / 2 ** 20
+    # generous slack for allocator overhead / transient copies, still
+    # far below the raw matrix
+    budget_mb = bins_mb + sample_mb + label_mb + 12 * chunk_mb + 64
+    print(json.dumps({
+        "delta_mb": round(delta, 1),
+        "raw_mb": round(raw_mb, 1),
+        "bins_mb": round(bins_mb, 1),
+        "budget_mb": round(budget_mb, 1),
+        "base_mb": round(base, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

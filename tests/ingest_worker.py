@@ -1,0 +1,100 @@
+"""Worker for the distributed streaming-ingestion tests
+(tests/test_data_ingest.py): one rank of a 2-process world that
+ingests ITS shard through a chunk source.
+
+All wiring comes from the environment (LIGHTGBM_TPU_COORDINATOR /
+NUM_PROCS / RANK picked up by a bare ``init_distributed()``, plus the
+fault/watchdog variables) — so it runs both spawned directly by a test
+and under ``python -m lightgbm_tpu launch``.
+
+Each rank builds the SAME global dataset twice through
+``spmd.distributed_dataset``:
+
+- eager: the raw shard array (mapper sync + re-bin + allgather),
+- streaming: an ``ArrayChunkSource`` over the shard (pass-1 mapper
+  sync inside the construct, binned-shard allgather, no raw matrix).
+
+It asserts bins/mappers/labels identical in-process, prints
+``INGEST_PARITY_OK``, trains both and asserts the models agree; rank 0
+writes ``model_stream.txt`` / ``model_eager.txt``. A LightGBMError (a
+watchdog abort — e.g. ``rank_kill@-1`` killing the peer before the
+pass-1 mapper sync) prints ``WORKER ABORT: <msg>`` and hard-exits 13.
+
+Usage: python ingest_worker.py <outdir> [num_rounds]
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+outdir = sys.argv[1]
+num_rounds = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+
+from lightgbm_tpu.parallel.distributed import init_distributed  # noqa: E402
+
+init_distributed()   # supervisor env (or single-process no-op)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.basic import LightGBMError  # noqa: E402
+from lightgbm_tpu.data import ArrayChunkSource  # noqa: E402
+from lightgbm_tpu.parallel import spmd  # noqa: E402
+
+rank = jax.process_index()
+nproc = jax.process_count()
+
+rs = np.random.RandomState(17)
+n, f = 800, 6
+X = rs.randn(n, f)
+y = (X @ rs.randn(f) > 0).astype(np.float64)
+shard = n // max(nproc, 1)
+lo, hi = rank * shard, (rank + 1) * shard
+params = {"objective": "binary", "num_leaves": 7, "max_bin": 63,
+          "min_data_in_leaf": 5, "seed": 3, "verbosity": -1}
+
+try:
+    ds_stream = spmd.distributed_dataset(
+        ArrayChunkSource(X[lo:hi], label=y[lo:hi], chunk_rows=128),
+        params=dict(params))
+    ds_eager = spmd.distributed_dataset(
+        X[lo:hi], label=y[lo:hi], params=dict(params))
+
+    assert ds_stream.num_data() == ds_eager.num_data() == n
+    assert [m.to_dict() for m in ds_stream.mappers] == \
+        [m.to_dict() for m in ds_eager.mappers], "mapper divergence"
+    np.testing.assert_array_equal(ds_stream.host_bins(),
+                                  ds_eager.host_bins())
+    np.testing.assert_array_equal(np.asarray(ds_stream.get_label()),
+                                  np.asarray(ds_eager.get_label()))
+    print(f"rank {rank} INGEST_PARITY_OK", flush=True)
+
+    bst_s = lgb.train(dict(params), ds_stream,
+                      num_boost_round=num_rounds)
+    bst_e = lgb.train(dict(params), ds_eager,
+                      num_boost_round=num_rounds)
+    assert bst_s.model_to_string() == bst_e.model_to_string(), \
+        "trained models diverge between ingestion modes"
+    # final barrier: rank 0 is the coordination-service leader, and an
+    # early exit would kill the peer mid-training with a fatal
+    # distributed-client error
+    from lightgbm_tpu.parallel.hostsync import host_allgather
+    host_allgather(np.asarray([rank], np.int64), "test/ingest_done")
+except LightGBMError as e:
+    print(f"WORKER ABORT: {e}", flush=True)
+    os._exit(13)
+
+if rank == 0:
+    bst_s.save_model(os.path.join(outdir, "model_stream.txt"))
+    bst_e.save_model(os.path.join(outdir, "model_eager.txt"))
+print(f"rank {rank} DONE iterations={bst_s.current_iteration()}",
+      flush=True)
+# skip jax.distributed atexit teardown: with peers already dead it can
+# block on the coordination service instead of exiting
+sys.stdout.flush()
+os._exit(0)

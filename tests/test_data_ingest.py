@@ -1,0 +1,695 @@
+"""Out-of-core streaming ingestion (lightgbm_tpu/data/, docs/DATA.md).
+
+Acceptance surface of the two-pass pipeline:
+
+1. parity — a chunked construct (array / generator / Sequence / CSV /
+   Arrow sources, chunk sizes that do and don't divide n) produces
+   BIT-IDENTICAL BinMappers, binned matrices and 10-round models vs
+   the in-memory path;
+2. the checkpoint data fingerprint accumulated during pass 2 equals
+   the eager digest, so resume works across ingestion modes and still
+   refuses different data;
+3. obs wiring — the `ingest` JSONL event, its `stats` row, and the
+   registry counters;
+4. memory — a `slow` subprocess proof that peak RSS stays O(chunk) on
+   a dataset 10x the chunk size (the raw float matrix would not fit
+   the asserted budget);
+5. distributed — a 2-process kv-transport world where each rank
+   ingests its shard through a chunk source (`mp`/`slow`), and the
+   chaos leg: `rank_kill@-1` during the pass-1 mapper sync must
+   watchdog-abort naming the collective, and the supervised relaunch
+   re-ingests cleanly.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import LightGBMError
+from lightgbm_tpu.data import (ArrayChunkSource, ArrowChunkSource,
+                               GeneratorChunkSource, dataset_digest)
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_DIR = os.path.dirname(TESTS_DIR)
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "max_bin": 63}
+
+
+def _make(n=4000, f=8, seed=3, nan_frac=0.05):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, f)
+    if nan_frac:
+        X[rs.rand(n, f) < nan_frac] = np.nan
+    y = (np.nansum(X[:, : max(1, f // 2)], axis=1) > 0).astype(
+        np.float64)
+    return X, y
+
+
+def _mappers(ds):
+    return [m.to_dict() for m in ds.mappers]
+
+
+def _assert_construct_parity(d_eager, d_stream):
+    d_eager.construct()
+    d_stream.construct()
+    assert _mappers(d_eager) == _mappers(d_stream)
+    np.testing.assert_array_equal(d_eager.host_bins(),
+                                  d_stream.host_bins())
+    assert d_stream.host_bins().dtype == d_eager.host_bins().dtype
+    np.testing.assert_array_equal(
+        np.asarray(d_eager.get_label()), np.asarray(d_stream.get_label()))
+    np.testing.assert_array_equal(d_eager.used_feature_indices(),
+                                  d_stream.used_feature_indices())
+
+
+# ---------------------------------------------------------------------
+# 1. streaming <-> eager parity
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("chunk", [1000, 999, 8192])
+def test_array_source_bit_identical_to_eager(chunk):
+    """Chunk sizes that divide n, don't divide n, and exceed n."""
+    X, y = _make()
+    d_e = lgb.Dataset(X, label=y, params=dict(PARAMS))
+    d_s = lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=chunk),
+                      params=dict(PARAMS))
+    _assert_construct_parity(d_e, d_s)
+    stats = d_s._ingest_stats
+    assert stats["rows"] == len(y)
+    assert stats["chunks"] == -(-len(y) // chunk)
+
+
+def test_trained_model_identical_over_10_rounds():
+    X, y = _make()
+    b_e = lgb.train(dict(PARAMS), lgb.Dataset(X, label=y,
+                                              params=dict(PARAMS)),
+                    num_boost_round=10)
+    b_s = lgb.train(dict(PARAMS),
+                    lgb.Dataset(ArrayChunkSource(X, label=y,
+                                                 chunk_rows=999),
+                                params=dict(PARAMS)),
+                    num_boost_round=10)
+    assert b_e.model_to_string() == b_s.model_to_string()
+
+
+def test_known_length_subsampled_mappers_bit_identical():
+    """bin_construct_sample_cnt < n: the streaming pass gathers the
+    EXACT rng.choice row set the eager constructor draws, so mappers
+    match bit-for-bit even on a strict subsample."""
+    X, y = _make(n=5000)
+    params = dict(PARAMS, bin_construct_sample_cnt=700)
+    d_e = lgb.Dataset(X, label=y, params=dict(params))
+    d_s = lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=640),
+                      params=dict(params))
+    _assert_construct_parity(d_e, d_s)
+
+
+def test_generator_factory_unknown_length_parity():
+    X, y = _make()
+
+    def factory():
+        for lo in range(0, len(y), 640):
+            yield X[lo:lo + 640], y[lo:lo + 640]
+
+    d_e = lgb.Dataset(X, label=y, params=dict(PARAMS))
+    d_s = lgb.Dataset(GeneratorChunkSource(factory), params=dict(PARAMS))
+    _assert_construct_parity(d_e, d_s)
+
+
+def test_bare_callable_is_accepted_as_factory():
+    X, y = _make(n=1200)
+
+    def chunks():
+        yield X[:500], y[:500]
+        yield X[500:], y[500:]
+
+    d_s = lgb.Dataset(chunks, params=dict(PARAMS))
+    d_e = lgb.Dataset(X, label=y, params=dict(PARAMS))
+    _assert_construct_parity(d_e, d_s)
+
+
+def test_csv_path_streams_with_ingest_chunk_rows(tmp_path):
+    X, y = _make(n=3000, f=6, nan_frac=0.0)
+    path = str(tmp_path / "train.csv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.6g")
+    d_e = lgb.Dataset(path, params=dict(PARAMS))
+    d_s = lgb.Dataset(path, params=dict(PARAMS, ingest_chunk_rows=700))
+    _assert_construct_parity(d_e, d_s)
+    assert d_s._ingest_stats["source"] == "CSVChunkSource"
+    # two_round's streamed result agrees too (same sampling seed)
+    d_t = lgb.Dataset(path, params=dict(PARAMS, two_round=True))
+    _assert_construct_parity(d_t, d_s)
+
+
+def test_csv_header_and_named_label_column(tmp_path):
+    X, y = _make(n=800, f=4, nan_frac=0.0)
+    path = str(tmp_path / "named.csv")
+    with open(path, "w") as fh:
+        fh.write("a,target,b,c,d\n")
+        block = np.column_stack([X[:, 0], y, X[:, 1:]])
+        np.savetxt(fh, block, delimiter=",", fmt="%.6g")
+    params = dict(PARAMS, header=True, label_column="name:target",
+                  ingest_chunk_rows=300)
+    d_s = lgb.Dataset(path, params=params)
+    d_s.construct()
+    np.testing.assert_array_equal(np.asarray(d_s.get_label()), y)
+    assert d_s.get_feature_name() == ["a", "b", "c", "d"]
+    d_e = lgb.Dataset(path, params=dict(PARAMS, header=True,
+                                        label_column="name:target"))
+    d_e.construct()
+    np.testing.assert_array_equal(d_e.host_bins(), d_s.host_bins())
+
+
+def test_sequence_inputs_route_through_streaming():
+    X, y = _make(n=900, f=4)
+
+    class ArrSeq(lgb.Sequence):
+        batch_size = 128
+
+        def __init__(self, arr):
+            self.arr = arr
+
+        def __getitem__(self, idx):
+            return self.arr[idx]
+
+        def __len__(self):
+            return len(self.arr)
+
+    d_s = lgb.Dataset([ArrSeq(X[:400]), ArrSeq(X[400:])], label=y,
+                      params=dict(PARAMS))
+    d_e = lgb.Dataset(X, label=y, params=dict(PARAMS))
+    _assert_construct_parity(d_e, d_s)
+    assert d_s._ingest_stats["source"] == "SequenceChunkSource"
+
+
+def test_streaming_valid_set_binned_against_reference():
+    X, y = _make()
+    Xv, yv = _make(n=700, seed=11)
+    d_tr = lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=512),
+                       params=dict(PARAMS))
+    d_v = d_tr.create_valid(ArrayChunkSource(Xv, label=yv,
+                                             chunk_rows=128))
+    bst = lgb.train(dict(PARAMS), d_tr, num_boost_round=5,
+                    valid_sets=[d_v])
+    assert bst.current_iteration() == 5
+    d_v_eager = lgb.Dataset(X, label=y, params=dict(PARAMS)) \
+        .create_valid(Xv, label=yv)
+    d_v_eager.construct()
+    np.testing.assert_array_equal(d_v.host_bins(), d_v_eager.host_bins())
+
+
+def test_weight_chunks_and_label_override():
+    X, y = _make(n=1000)
+    w = np.random.RandomState(0).rand(1000) + 0.5
+    src = ArrayChunkSource(X, label=y, weight=w, chunk_rows=300)
+    d_s = lgb.Dataset(src, params=dict(PARAMS))
+    d_s.construct()
+    np.testing.assert_array_equal(np.asarray(d_s.get_weight()), w)
+    # an explicit label argument overrides the source's labels — and
+    # the fingerprint must follow the override
+    y2 = 1.0 - y
+    d_o = lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=300),
+                      label=y2, params=dict(PARAMS))
+    d_o.construct()
+    np.testing.assert_array_equal(np.asarray(d_o.get_label()), y2)
+    assert d_o._data_digest == dataset_digest(y2, d_o.host_bins())
+
+
+def test_categorical_ctor_arg_takes_precedence_over_params():
+    """Eager resolution lets the categorical_feature ARGUMENT win over
+    the params spec; streaming must match or bit-parity (and the
+    cross-mode checkpoint digest) breaks."""
+    rs = np.random.RandomState(5)
+    n = 1200
+    X = np.column_stack([rs.randint(0, 6, n).astype(float),
+                         rs.randint(0, 6, n).astype(float),
+                         rs.randn(n)])
+    y = (X[:, 2] > 0).astype(np.float64)
+    params = dict(PARAMS, categorical_feature="1")
+    d_e = lgb.Dataset(X, label=y, params=dict(params),
+                      categorical_feature=[0])
+    d_s = lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=500),
+                      params=dict(params), categorical_feature=[0])
+    _assert_construct_parity(d_e, d_s)
+
+
+def test_custom_source_with_float32_labels_digest_parity():
+    """A RowChunkSource subclass yielding float32 labels (never passed
+    through a built-in adapter): the incremental digest must hash the
+    float64-normalized bytes, or cross-mode resume refuses identical
+    data."""
+    from lightgbm_tpu.data import RowChunk, RowChunkSource
+
+    X, y = _make(n=900)
+
+    class F32Source(RowChunkSource):
+        def num_rows(self):
+            return len(y)
+
+        def chunks(self):
+            for lo in range(0, len(y), 250):
+                yield RowChunk(X[lo:lo + 250].astype(np.float32),
+                               y[lo:lo + 250].astype(np.float32))
+
+    d_s = lgb.Dataset(F32Source(), params=dict(PARAMS))
+    d_s.construct()
+    d_e = lgb.Dataset(X.astype(np.float32), label=y,
+                      params=dict(PARAMS))
+    d_e.construct()
+    np.testing.assert_array_equal(d_e.host_bins(), d_s.host_bins())
+    assert d_s._data_digest == dataset_digest(
+        np.asarray(d_e.get_label(), np.float64), d_e.host_bins())
+
+
+def test_categorical_int_indices_parity():
+    rs = np.random.RandomState(7)
+    n = 1500
+    X = np.column_stack([rs.randint(0, 8, n).astype(float),
+                         rs.randn(n), rs.randn(n)])
+    y = (X[:, 1] + (X[:, 0] > 3) > 0).astype(np.float64)
+    params = dict(PARAMS, categorical_feature=[0])
+    d_e = lgb.Dataset(X, label=y, params=dict(params),
+                      categorical_feature=[0])
+    d_s = lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=400),
+                      params=dict(params), categorical_feature=[0])
+    _assert_construct_parity(d_e, d_s)
+
+
+def _has_pyarrow():
+    try:
+        import pyarrow  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+@pytest.mark.skipif(not _has_pyarrow(), reason="pyarrow not installed")
+def test_arrow_table_and_parquet_sources(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    X, y = _make(n=1100, f=5, nan_frac=0.0)
+    table = pa.table({"label": y,
+                      **{f"f{j}": X[:, j] for j in range(X.shape[1])}})
+    src = ArrowChunkSource(table, chunk_rows=256, label_column="label")
+    d_s = lgb.Dataset(src, params=dict(PARAMS))
+    d_e = lgb.Dataset(X, label=y, params=dict(PARAMS))
+    _assert_construct_parity(d_e, d_s)
+    assert d_s.get_feature_name() == [f"f{j}" for j in range(5)]
+
+    pq_path = str(tmp_path / "train.parquet")
+    pq.write_table(table, pq_path, row_group_size=300)
+    src2 = ArrowChunkSource(pq_path, chunk_rows=256,
+                            label_column="label")
+    assert src2.num_rows() == 1100
+    d_p = lgb.Dataset(src2, params=dict(PARAMS))
+    _assert_construct_parity(d_e, d_p)
+
+    # path streaming honors cfg.label_column (name: and index forms) —
+    # ignoring it would train on the label as a feature
+    d_q = lgb.Dataset(pq_path, params=dict(
+        PARAMS, ingest_chunk_rows=256, label_column="name:label"))
+    _assert_construct_parity(d_e, d_q)
+    assert d_q.get_feature_name() == [f"f{j}" for j in range(5)]
+    d_i = lgb.Dataset(pq_path, params=dict(PARAMS,
+                                           ingest_chunk_rows=256))
+    _assert_construct_parity(d_e, d_i)  # default: first schema column
+
+
+# ---------------------------------------------------------------------
+# 2. error surface
+# ---------------------------------------------------------------------
+
+def test_generator_object_rejected_with_clear_error():
+    X, y = _make(n=500)
+    gen = iter([(X, y)])  # consumable once: useless for two passes
+    with pytest.raises(LightGBMError):
+        lgb.Dataset(GeneratorChunkSource(gen), params=dict(PARAMS))
+
+
+def test_inconsistent_feature_width_raises():
+    def factory():
+        yield np.zeros((10, 4)), np.zeros(10)
+        yield np.zeros((10, 5)), np.zeros(10)
+
+    with pytest.raises(LightGBMError, match="features"):
+        lgb.Dataset(GeneratorChunkSource(factory),
+                    params=dict(PARAMS)).construct()
+
+
+def test_labels_must_be_consistent_across_chunks():
+    def factory():
+        yield np.zeros((10, 3)), np.zeros(10)
+        yield np.zeros((10, 3))
+
+    with pytest.raises(LightGBMError, match="labels"):
+        lgb.Dataset(GeneratorChunkSource(factory),
+                    params=dict(PARAMS)).construct()
+
+
+def test_array_source_rejects_mismatched_metadata_lengths():
+    """A LONGER label slices cleanly against every chunk, so without
+    an up-front check it would be silently truncated."""
+    X, _ = _make(n=100)
+    with pytest.raises(LightGBMError, match="Length of label"):
+        ArrayChunkSource(X, label=np.zeros(150))
+    with pytest.raises(LightGBMError, match="Length of weight"):
+        ArrayChunkSource(X, label=np.zeros(100), weight=np.ones(80))
+
+
+def test_weights_must_be_consistent_across_chunks():
+    def factory():
+        yield np.zeros((10, 3)), np.zeros(10), np.ones(10)
+        yield np.zeros((10, 3)), np.zeros(10)
+
+    with pytest.raises(LightGBMError, match="weights"):
+        lgb.Dataset(GeneratorChunkSource(factory),
+                    params=dict(PARAMS)).construct()
+
+
+def test_declared_row_count_must_match_stream():
+    X, y = _make(n=300)
+
+    def factory():
+        yield X, y
+
+    with pytest.raises(LightGBMError, match="declared"):
+        lgb.Dataset(GeneratorChunkSource(factory, num_rows=400),
+                    params=dict(PARAMS)).construct()
+
+
+def test_empty_source_raises():
+    with pytest.raises(LightGBMError, match="no rows"):
+        lgb.Dataset(GeneratorChunkSource(lambda: iter(())),
+                    params=dict(PARAMS)).construct()
+
+
+def test_missing_label_raises():
+    X, _ = _make(n=200)
+    with pytest.raises(LightGBMError, match="Label"):
+        lgb.Dataset(ArrayChunkSource(X, chunk_rows=100),
+                    params=dict(PARAMS)).construct()
+
+
+def test_linear_tree_streaming_retains_raw_and_matches_eager():
+    """linear_tree needs raw values: pass 2 retains the used-column
+    f32 matrix at the eager path's exact cost (Sequence inputs used
+    to materialize for this; streaming must not regress it)."""
+    X, y = _make(n=1200, nan_frac=0.0)
+    y = X[:, 0] * 2.0 + y
+    params = dict(PARAMS, objective="regression", linear_tree=True)
+    d_s = lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=500),
+                      params=dict(params))
+    d_e = lgb.Dataset(X, label=y, params=dict(params))
+    d_s.construct()
+    d_e.construct()
+    np.testing.assert_array_equal(d_s.raw_numeric(), d_e.raw_numeric())
+    b_s = lgb.train(dict(params),
+                    lgb.Dataset(ArrayChunkSource(X, label=y,
+                                                 chunk_rows=500),
+                                params=dict(params)),
+                    num_boost_round=5)
+    b_e = lgb.train(dict(params), lgb.Dataset(X, label=y,
+                                              params=dict(params)),
+                    num_boost_round=5)
+    assert b_s.model_to_string() == b_e.model_to_string()
+
+
+def test_set_label_after_streaming_construct_refreshes_digest(tmp_path):
+    """set_label() on a constructed streaming dataset must invalidate
+    the precomputed fingerprint, or two runs differing only via
+    set_label would share a digest and the checkpoint guard would
+    resume across them."""
+    X, y = _make(n=600)
+    ds = lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=200),
+                     params=dict(PARAMS))
+    ds.construct()
+    assert ds._data_digest is not None
+    ds.set_label(1.0 - y)
+    assert ds._data_digest is None  # checkpoint layer rehashes
+
+
+def test_libsvm_path_falls_back_to_eager(tmp_path):
+    path = str(tmp_path / "train.svm")
+    with open(path, "w") as fh:
+        for i in range(200):
+            fh.write(f"{i % 2} 0:{i * 0.1:.3f} 2:{(200 - i) * 0.5:.3f}\n")
+    ds = lgb.Dataset(path, params=dict(PARAMS, ingest_chunk_rows=64))
+    ds.construct()  # streamed loaders cannot do ragged rows: eager path
+    assert getattr(ds, "_ingest_stats", None) is None
+    assert ds.num_data() == 200
+
+
+def test_ingest_chunk_rows_param_validation():
+    with pytest.raises(ValueError):
+        lgb.Config.from_params({"ingest_chunk_rows": -1})
+    assert lgb.Config.from_params(
+        {"ingest_chunk_rows": "4096"}).ingest_chunk_rows == 4096
+
+
+# ---------------------------------------------------------------------
+# 3. checkpoint fingerprint: incremental digest == eager digest
+# ---------------------------------------------------------------------
+
+def test_streaming_digest_equals_eager_digest():
+    X, y = _make()
+    d_e = lgb.Dataset(X, label=y, params=dict(PARAMS))
+    d_e.construct()
+    d_s = lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=999),
+                      params=dict(PARAMS))
+    d_s.construct()
+    assert d_s._data_digest == dataset_digest(
+        np.asarray(d_e.get_label(), np.float64), d_e.host_bins())
+
+
+def test_resume_works_across_ingestion_modes_and_refuses_other_data(
+        tmp_path):
+    X, y = _make(n=1500)
+    ck = str(tmp_path / "ckpts")
+    params = dict(PARAMS, seed=3)
+
+    def stream_ds():
+        return lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=400),
+                           params=dict(params))
+
+    lgb.train(dict(params), stream_ds(), num_boost_round=4,
+              callbacks=[lgb.checkpoint(ck)])
+    # resume the STREAMING run from an EAGER dataset of the same data:
+    # the incremental pass-2 digest must match the eager fingerprint
+    resumed = lgb.train(dict(params),
+                        lgb.Dataset(X, label=y, params=dict(params)),
+                        num_boost_round=8, resume_from=ck)
+    uninterrupted = lgb.train(dict(params), stream_ds(),
+                              num_boost_round=8)
+    assert resumed.model_to_string() == uninterrupted.model_to_string()
+    # ...and a streaming dataset of DIFFERENT data is refused
+    X2, y2 = _make(n=1500, seed=99)
+    with pytest.raises(LightGBMError, match="different training data"):
+        lgb.train(dict(params),
+                  lgb.Dataset(ArrayChunkSource(X2, label=y2,
+                                               chunk_rows=400),
+                              params=dict(params)),
+                  num_boost_round=8, resume_from=ck)
+
+
+# ---------------------------------------------------------------------
+# 4. obs wiring: ingest event, stats row, counters
+# ---------------------------------------------------------------------
+
+def test_ingest_event_and_stats_row(tmp_path):
+    from lightgbm_tpu.obs.recorder import (render_stats_table,
+                                           summarize_events)
+    X, y = _make(n=1200)
+    telem = str(tmp_path / "t.jsonl")
+    ds = lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=300),
+                     params=dict(PARAMS))
+    lgb.train(dict(PARAMS), ds, num_boost_round=3,
+              callbacks=[lgb.telemetry(telem)])
+    events = [json.loads(line) for line in open(telem)]
+    ingest_events = [e for e in events if e["event"] == "ingest"]
+    assert len(ingest_events) == 1
+    ev = ingest_events[0]
+    assert ev["rows"] == 1200 and ev["chunks"] == 4
+    assert ev["pass1_s"] >= 0 and ev["pass2_s"] >= 0
+    summary = summarize_events(telem)
+    assert summary["ingest"]["rows"] == 1200
+    assert summary["iterations"] == 3
+    table = render_stats_table(summary)
+    assert "ingest" in table and "1200 rows / 4 chunks" in table
+
+
+def test_ingest_registry_counters():
+    from lightgbm_tpu.obs.registry import registry
+    X, y = _make(n=800)
+    before_chunks = registry.counter("ingest_chunks").value
+    before_rows = registry.counter("ingest_rows").value
+    lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=200),
+                params=dict(PARAMS)).construct()
+    assert registry.counter("ingest_chunks").value == before_chunks + 4
+    assert registry.counter("ingest_rows").value == before_rows + 800
+
+
+def test_ingest_phases_visible_in_timer():
+    from lightgbm_tpu.utils.timer import Timer
+    X, y = _make(n=600)
+    Timer.enable()
+    try:
+        lgb.Dataset(ArrayChunkSource(X, label=y, chunk_rows=200),
+                    params=dict(PARAMS)).construct()
+        snap = Timer.snapshot()
+    finally:
+        Timer.enable(False)
+    assert "ingest/pass1" in snap and "ingest/pass2" in snap
+
+
+# ---------------------------------------------------------------------
+# 5. the data/ package stays jax-free
+# ---------------------------------------------------------------------
+
+def test_data_package_never_imports_jax():
+    """The ingestion path must stay jax-import-lazy: importing the
+    package AND running the full two-pass pipeline directly (sources +
+    ingest_dataset on a single process) must not pull jax in. (The
+    Dataset facade inevitably imports jax — ``basic`` does at module
+    level — which is exactly why data/ raises through a lazy error
+    helper instead of importing ``LightGBMError`` eagerly.)"""
+    code = (
+        "import sys\n"
+        "import numpy as np\n"
+        "from lightgbm_tpu.config import Config\n"
+        "from lightgbm_tpu.data import (ArrayChunkSource,\n"
+        "                               ingest_dataset)\n"
+        "X = np.random.RandomState(0).randn(500, 4)\n"
+        "y = (X[:, 0] > 0).astype(np.float64)\n"
+        "cfg = Config.from_params({'max_bin': 63,\n"
+        "                          'ingest_chunk_rows': 128})\n"
+        "res = ingest_dataset(ArrayChunkSource(X, label=y), cfg, set())\n"
+        "assert res.n == 500 and res.bins.shape == (500, 4)\n"
+        "assert res.digest is not None\n"
+        "assert 'jax' not in sys.modules, 'ingestion imported jax!'\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO_DIR,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------
+# 6. memory budget: peak RSS stays O(chunk), never O(raw matrix)
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_peak_rss_bounded_by_chunk_footprint_not_dataset(tmp_path):
+    """A dataset >= 10x the chunk size constructs within a budget the
+    raw float matrix could not fit (tests/ingest_mem_worker.py runs in
+    a subprocess so ru_maxrss is clean)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(TESTS_DIR, "ingest_mem_worker.py")],
+        capture_output=True, text=True, timeout=540, cwd=REPO_DIR)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    report = json.loads(proc.stdout.strip().splitlines()[-1])
+    # the raw matrix alone would add >= raw_mb over baseline; the
+    # streaming construct must stay under half of it
+    assert report["delta_mb"] < report["raw_mb"] / 2, report
+    assert report["delta_mb"] < report["budget_mb"], report
+
+
+# ---------------------------------------------------------------------
+# 7. distributed: 2-process shard ingestion + chaos
+# ---------------------------------------------------------------------
+
+def _worker_env(tmp_path, port, rank, fault="", extra=None):
+    from _mp_utils import worker_base_env
+    env = worker_base_env({
+        "LIGHTGBM_TPU_COORDINATOR": f"127.0.0.1:{port}",
+        "LIGHTGBM_TPU_NUM_PROCS": "2",
+        "LIGHTGBM_TPU_RANK": str(rank),
+        "LIGHTGBM_TPU_FAULT_INJECT": fault,
+        "LIGHTGBM_TPU_FAULT_RANK": "1",
+        "LIGHTGBM_TPU_COLLECTIVE_TIMEOUT": "15",
+        "LIGHTGBM_TPU_INIT_BACKOFF": "0.05",
+    })
+    if extra:
+        env.update(extra)
+    return env
+
+
+@pytest.mark.mp
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_two_process_streaming_shards_match_eager_distributed(tmp_path):
+    """Each rank ingests its shard through a chunk source; the gathered
+    global dataset — and the trained model — must be identical to the
+    eager distributed_dataset path (the worker asserts bins/mappers
+    in-process and rank 0 writes both models)."""
+    from _mp_utils import drain_all, free_port, spawn_worker
+    port = free_port()
+    worker = os.path.join(TESTS_DIR, "ingest_worker.py")
+    procs = [
+        spawn_worker([worker, str(tmp_path)],
+                     _worker_env(tmp_path, port, rank))
+        for rank in (0, 1)
+    ]
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            drain_all(procs, "2-process streaming ingest hung")
+        outs.append(out.decode(errors="replace"))
+    for rank, (p, text) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{text}"
+        assert "INGEST_PARITY_OK" in text, text
+    m_stream = (tmp_path / "model_stream.txt").read_bytes()
+    m_eager = (tmp_path / "model_eager.txt").read_bytes()
+    assert m_stream == m_eager
+
+
+@pytest.mark.mp
+@pytest.mark.slow
+@pytest.mark.timeout(600)
+def test_rank_kill_during_pass1_sync_aborts_then_relaunch_reingests(
+        tmp_path):
+    """The chaos tie-in: rank_kill@-1 kills rank 1 right before the
+    pass-1 mapper sync; the survivor must watchdog-abort NAMING the
+    collective (no hang), and the supervised relaunch — with the
+    one-shot fault stripped — re-ingests and trains to completion."""
+    from _mp_utils import worker_base_env
+    worker = os.path.join(TESTS_DIR, "ingest_worker.py")
+    outdir = tmp_path / "chaos"
+    outdir.mkdir()
+    env = worker_base_env({
+        "JAX_PLATFORMS": "cpu",
+        "LIGHTGBM_TPU_FAULT_INJECT": "rank_kill@-1",
+        "LIGHTGBM_TPU_FAULT_RANK": "1",
+        "LIGHTGBM_TPU_COLLECTIVE_TIMEOUT": "15",
+        "LIGHTGBM_TPU_INIT_BACKOFF": "0.05",
+    })
+    proc = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "launch", "2",
+         "--max-restarts", "2", "--log-dir", str(outdir),
+         "--grace", "30", "--",
+         sys.executable, worker, str(outdir)],
+        env=env, cwd=REPO_DIR, capture_output=True, text=True,
+        timeout=540)
+    logs = {name: (outdir / name).read_text(errors="replace")
+            for name in os.listdir(outdir) if name.endswith(".log")}
+    detail = "\n".join(f"--- {k} ---\n{v[-2000:]}"
+                       for k, v in sorted(logs.items()))
+    assert proc.returncode == 0, \
+        f"{proc.stdout}\n{proc.stderr}\n{detail}"
+    g0 = logs.get("elastic_g0_rank0.log", "")
+    # generation 0: the survivor aborted naming the stuck collective
+    assert "WORKER ABORT" in g0, detail
+    assert "spmd/sync_bin_mappers" in g0, detail
+    # generation 1: fault stripped, full re-ingest + training finished
+    g1 = logs.get("elastic_g1_rank0.log", "")
+    assert "INGEST_PARITY_OK" in g1, detail
+    assert "DONE" in g1, detail
+    assert (tmp_path / "chaos" / "model_stream.txt").exists(), detail

@@ -172,6 +172,7 @@ _FIXTURES = [
     "obs/tpl006_pos.py", "obs/tpl006_neg.py",
     "resilience/tpl006_pos.py", "resilience/tpl006_neg.py",
     "tpl007_pos.py", "tpl007_neg.py",
+    "data/tpl007_pos.py", "data/tpl007_neg.py",
     "obs/tpl008_pos.py", "obs/tpl008_neg.py",
     "obs/tpl008_pragma.py",
     "tpl009_pos.py", "tpl009_neg.py",
