@@ -191,6 +191,15 @@ PRESET = os.environ.get("BENCH_PRESET", "higgs")
 _ALLSTATE = PRESET == "allstate"
 _STREAMING = (os.environ.get("BENCH_STREAMING", "") == "1"
               or "--streaming" in sys.argv)
+# BENCH_SERVE=1 / --serve: after the training legs, benchmark the
+# production inference path (lightgbm_tpu/serve/, docs/SERVING.md) —
+# compiled shape-bucketed predict vs the eager Booster.predict CPU
+# baseline over a mix of ad-hoc batch sizes; rows/sec, p50/p99 request
+# latency and the recompile count after warmup ride along in a
+# "serve" block of the one JSON line.
+_SERVE = (os.environ.get("BENCH_SERVE", "") == "1"
+          or "--serve" in sys.argv)
+SERVE_REPEAT = int(os.environ.get("BENCH_SERVE_REPEAT", 3))
 # rows per ingest chunk in streaming mode (the peak-RSS knob)
 INGEST_CHUNK = int(os.environ.get("BENCH_INGEST_CHUNK", 262_144))
 ALLSTATE_ROWS = 13_184_290
@@ -292,6 +301,56 @@ def make_allstate_like(n, f, seed=0, per_group=128):
         y[row:row + len(yc)] = yc
         row += len(yc)
     return X, y
+
+
+def _serve_bench(bst, lgb_obs, n_features):
+    """The serving leg: compiled shape-bucketed prediction vs the
+    eager ``Booster.predict`` baseline, over a mix of ad-hoc batch
+    sizes (the daemon's actual workload shape).
+
+    Both sides are measured steady-state: the eager baseline gets one
+    untimed pass to populate its per-shape jit caches (so the compiled
+    win measures the re-stack + bucketing advantage, not first-call
+    compiles), and the compiled side is warmed through its power-of-two
+    buckets — after which its recompile counter must stay flat (the
+    TPL003 serving invariant; reported for the record)."""
+    import lightgbm_tpu as lgb
+    rs = np.random.RandomState(99)
+    sizes = [1, 3, 17, 33, 100, 257, 512, 777, 1024, 2000]
+    reqs = [rs.randn(s, n_features).astype(np.float32) for s in sizes]
+    rows = sum(sizes) * SERVE_REPEAT
+
+    eager = lgb.Booster(model_str=bst.model_to_string())
+    for X in reqs:
+        eager.predict(X)                      # untimed warm pass
+    t0 = time.time()
+    for _ in range(SERVE_REPEAT):
+        for X in reqs:
+            eager.predict(X)
+    dt_eager = time.time() - t0
+
+    cf = bst.compile(max_batch_rows=4096)
+    cf.warmup()
+    watch = lgb_obs.RecompileWatcher()
+    lat = []
+    t0 = time.time()
+    for _ in range(SERVE_REPEAT):
+        for X in reqs:
+            t = time.perf_counter()
+            bst.predict(X)                    # routed through cf
+            lat.append(time.perf_counter() - t)
+    dt_compiled = time.time() - t0
+    lat_ms = np.asarray(lat) * 1e3
+    return {
+        "batch_sizes": sizes,
+        "repeat": SERVE_REPEAT,
+        "rows_per_sec_compiled": round(rows / dt_compiled, 1),
+        "rows_per_sec_eager": round(rows / dt_eager, 1),
+        "speedup_vs_eager": round(dt_eager / dt_compiled, 3),
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        "recompiles_after_warmup": watch.delta(),
+    }
 
 
 def _peak_rss_bytes():
@@ -460,6 +519,8 @@ def main():
                    for label, v in top_phases},
         "hbm": lgb_obs.device_memory_stats(),
     }
+    if _SERVE:
+        result["serve"] = _serve_bench(bst, lgb_obs, N_FEATURES)
     if result_auc is not None:
         result["auc"] = round(result_auc, 6)
         # the oracle was measured against the exact eager single-stream

@@ -53,7 +53,7 @@ _SUBMODULES = {
     "analysis", "basic", "callback", "cli", "config", "convert",
     "data", "engine", "metrics", "models", "objectives", "obs", "ops",
     "parallel", "plotting", "prediction", "ranking", "resilience",
-    "shap", "sklearn", "utils",
+    "serve", "shap", "sklearn", "utils",
 }
 
 

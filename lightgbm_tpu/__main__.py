@@ -17,5 +17,14 @@ if __name__ == "__main__":
         from .resilience.elastic import main as launch_main
         raise SystemExit(launch_main(sys.argv[2:]))
 
+    # `serve` is the inference daemon (serve/daemon.py). Its argument
+    # parse, --help and bad-model-path errors are jax-free (the serve
+    # package __init__ is PEP-562 lazy); jax loads only once a model
+    # is actually compiled — so operator typos fail fast even where no
+    # backend can initialize.
+    if len(sys.argv) > 1 and sys.argv[1] == "serve":
+        from .serve.daemon import main as serve_main
+        raise SystemExit(serve_main(sys.argv[2:]))
+
     from .cli import main
     raise SystemExit(main())

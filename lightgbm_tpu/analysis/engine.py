@@ -24,10 +24,12 @@ __all__ = ["run_lint", "LintResult", "default_scope", "package_root",
 #: rule scope: the boosting hot path (ISSUE scope floor: models/,
 #: ops/, parallel/, engine.py, resilience/ — plus obs/ for TPL006,
 #: data/ for the ingestion pipeline's pass-1/pass-2 host collectives
-#: (TPL007) and jax-laziness, and the per-iteration device-code
-#: modules at package root).
+#: (TPL007) and jax-laziness, serve/ for the inference daemon's
+#: batcher/watcher thread contract (TPL006/TPL008) and its bucketed
+#: jit program (TPL003), and the per-iteration device-code modules at
+#: package root).
 _SCOPE_DIRS = ("models/", "ops/", "parallel/", "resilience/", "obs/",
-               "data/")
+               "data/", "serve/")
 _SCOPE_FILES = ("engine.py", "ranking.py", "prediction.py",
                 "metrics.py", "objectives.py", "shap.py")
 

@@ -483,12 +483,16 @@ class LockAcrossDispatch(Rule):
     ``resilience/`` the same shape is worse: the collective watchdog's
     bookkeeping lock held across a *collective* would hang the exact
     abort path that exists to break hangs (watchdog.py's contract is
-    copy-under-lock, sync-outside)."""
+    copy-under-lock, sync-outside). ``serve/`` inherits the same
+    contract: the micro-batcher's lock held across the compiled
+    predict dispatch would stall every submit()/stats() caller behind
+    one slow device batch."""
 
     id = "TPL006"
-    title = "lock held across jax dispatch in obs/ or resilience/"
+    title = "lock held across jax dispatch in obs/, resilience/ " \
+            "or serve/"
 
-    _SCOPE_PREFIXES = ("obs/", "resilience/")
+    _SCOPE_PREFIXES = ("obs/", "resilience/", "serve/")
     _LOCK_CALLS = {"Lock", "RLock", "Condition", "Semaphore"}
 
     def run(self, ctx: LintContext) -> Iterator[Finding]:

@@ -257,12 +257,14 @@ class ThreadSharedState(Rule):
     method call (``append``/``update``/...). A module global mutated
     from thread-side code is flagged even without a main-path reader:
     every spawn is a *fresh* thread, so two successive collectives
-    already race on it."""
+    already race on it. Scope includes ``serve/``: the inference
+    daemon's batcher worker, hot-swap watcher and stats loop all
+    mutate state that submit()/stats() callers read concurrently."""
 
     id = "TPL008"
     title = "thread-shared state mutated without a common lock"
 
-    _SCOPE_PREFIXES = ("obs/", "resilience/", "parallel/")
+    _SCOPE_PREFIXES = ("obs/", "resilience/", "parallel/", "serve/")
 
     def run(self, ctx: LintContext) -> Iterator[Finding]:
         thread_side = thread_side_functions(ctx.graph)

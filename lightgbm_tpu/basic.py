@@ -1276,6 +1276,7 @@ class Booster:
         self._feature_infos: List[str] = []
         self._objective_str = "none"
         self._avg_output = False
+        self._compiled_forest = None
 
         if train_set is not None:
             if not isinstance(train_set, Dataset):
@@ -1446,6 +1447,27 @@ class Booster:
             pred_early_stop_freq=int(kwargs.get("pred_early_stop_freq", 10)),
             pred_early_stop_margin=float(
                 kwargs.get("pred_early_stop_margin", 10.0)))
+
+    def compile(self, num_iteration: Optional[int] = None,
+                start_iteration: int = 0, **kwargs):
+        """Compile the forest once into tensorized device arrays
+        (serve/compile.py): the returned
+        :class:`~lightgbm_tpu.serve.compile.CompiledForest` predicts
+        through ONE jitted program with power-of-two row bucketing,
+        and subsequent :meth:`predict` calls over the same iteration
+        range ride it too — ad-hoc batch sizes stop triggering
+        per-shape recompiles. The cached compilation is bypassed
+        automatically when the booster trains further or a different
+        iteration range is requested. ``kwargs``:
+        ``min_bucket`` / ``max_batch_rows`` (powers of two)."""
+        if num_iteration is None:
+            num_iteration = self.best_iteration \
+                if self.best_iteration > 0 else -1
+        from .serve.compile import compile_forest
+        cf = compile_forest(self, num_iteration=num_iteration,
+                            start_iteration=start_iteration, **kwargs)
+        self._compiled_forest = cf
+        return cf
 
     # -- model io ----------------------------------------------------------
     def model_to_string(self, num_iteration: Optional[int] = None,

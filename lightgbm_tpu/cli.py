@@ -12,6 +12,7 @@ Usage:
     python -m lightgbm_tpu checkpoints <dir>   # inspect snapshots
     python -m lightgbm_tpu lint [--help]       # tpulint static analyzer
     python -m lightgbm_tpu launch 4 -- <cmd>   # elastic restart supervisor
+    python -m lightgbm_tpu serve model.txt     # inference daemon
 
 Config-file syntax matches the reference (application.cpp:50-86 +
 config.cpp KV2Map): one ``key = value`` per line, ``#`` comments;
@@ -180,13 +181,14 @@ usage: python -m lightgbm_tpu stats <file.jsonl>
 
 Fold a telemetry event stream (lightgbm_tpu.telemetry(path) callback /
 LIGHTGBM_TPU_TELEMETRY=<path>) into the sorted per-phase summary table:
-wall time, recompiles, peak HBM, fault events, final evals, and a
-per-phase total/count/mean/percent/skew breakdown. See
-docs/OBSERVABILITY.md.
+wall time, recompiles, peak HBM, fault events, final evals, a serve
+summary row when the file carries {"event": "serve"} daemon lines
+(docs/SERVING.md), and a per-phase total/count/mean/percent/skew
+breakdown. See docs/OBSERVABILITY.md.
 
 exit codes:
   0  summary printed
-  1  unreadable/malformed file, or no iteration events in it
+  1  unreadable/malformed file, or no iteration/serve events in it
 """
 
 _CHECKPOINTS_HELP = """\
@@ -226,8 +228,9 @@ def _task_stats(argv: List[str]) -> int:
         print(f"[LightGBM-TPU] [Fatal] malformed telemetry in {path}: "
               f"{e}", file=sys.stderr)
         return 1
-    if summary["iterations"] == 0:
-        print(f"no iteration events in {path}", file=sys.stderr)
+    if summary["iterations"] == 0 and not summary.get("serve"):
+        print(f"no iteration or serve events in {path}",
+              file=sys.stderr)
         return 1
     print(render_stats_table(summary))
     return 0
@@ -314,6 +317,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         # programmatic main() callers
         from .resilience.elastic import main as launch_main
         return launch_main(argv[1:])
+    if argv[0] == "serve":
+        # likewise dispatched (jax-lazily) in __main__.py; kept here
+        # for programmatic main() callers
+        from .serve.daemon import main as serve_main
+        return serve_main(argv[1:])
     try:
         params = parse_args(argv)
         cfg = Config.from_params(params)

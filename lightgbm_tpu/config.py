@@ -462,6 +462,27 @@ class Config:
     pred_early_stop_margin: float = 10.0
     output_result: str = "LightGBM_predict_result.txt"
 
+    # ---- serve ----
+    # production inference daemon (lightgbm_tpu/serve/,
+    # docs/SERVING.md): micro-batching window in milliseconds — how
+    # long the batcher waits for more requests before dispatching a
+    # partial batch (0 = dispatch immediately)
+    serve_batch_window_ms: float = 2.0
+    # largest device batch (power of two); bigger requests are split,
+    # smaller ones pad up to their power-of-two bucket so arbitrary
+    # request sizes never recompile the predict program
+    serve_max_batch_rows: int = 16384
+    # smallest row bucket (power of two): requests below it pad to it,
+    # bounding the jit cache at log2(max/min)+1 entries per model
+    serve_min_bucket_rows: int = 16
+    # pending-row budget: a submit that would exceed it is rejected
+    # (backpressure) instead of growing an unbounded queue
+    serve_queue_rows: int = 131072
+    # seconds between {"event": "serve"} telemetry lines
+    serve_stats_interval_sec: float = 10.0
+    # seconds between polls of the hot-swap watch directory
+    serve_watch_interval_sec: float = 1.0
+
     # ---- convert ----
     convert_model_language: str = ""
     convert_model: str = "gbdt_prediction.cpp"
@@ -596,6 +617,12 @@ class Config:
         "num_grad_quant_bins": (2, None),
         "num_machines": (1, None),
         "collective_timeout_sec": (0.0, None),
+        "serve_batch_window_ms": (0.0, None),
+        "serve_max_batch_rows": (1, None),
+        "serve_min_bucket_rows": (1, None),
+        "serve_queue_rows": (1, None),
+        "serve_stats_interval_sec": (0.0, None, "gt"),
+        "serve_watch_interval_sec": (0.0, None, "gt"),
         "metric_freq": (1, None),
         "multi_error_top_k": (1, None),
     }
@@ -643,6 +670,16 @@ class Config:
             raise ValueError(
                 f"Unknown nonfinite_policy: {self.nonfinite_policy} "
                 "(expected raise, skip_tree or clamp)")
+        for name in ("serve_max_batch_rows", "serve_min_bucket_rows"):
+            v = getattr(self, name)
+            if v < 1 or (v & (v - 1)) != 0:
+                raise ValueError(f"{name} must be a power of two >= 1, "
+                                 f"got {v}")
+        if self.serve_min_bucket_rows > self.serve_max_batch_rows:
+            raise ValueError(
+                "serve_min_bucket_rows must be <= serve_max_batch_rows "
+                f"({self.serve_min_bucket_rows} > "
+                f"{self.serve_max_batch_rows})")
         for name, spec in self._BOUNDS.items():
             lo, hi = spec[0], spec[1]
             strict = len(spec) > 2 and spec[2] == "gt"

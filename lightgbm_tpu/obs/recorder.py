@@ -343,6 +343,8 @@ def summarize_events(path: str) -> dict:
     last_eval: Dict[str, float] = {}
     faults: Dict[str, int] = {}
     ingest: Optional[Dict[str, float]] = None
+    serve: Optional[Dict[str, object]] = None
+    serve_events = 0
 
     def _parse(line: str, is_last: bool) -> Optional[dict]:
         try:
@@ -371,6 +373,12 @@ def summarize_events(path: str) -> dict:
             continue
         if ev.get("event") == "ingest":
             ingest = {k: v for k, v in ev.items() if k != "event"}
+            continue
+        if ev.get("event") == "serve":
+            # serve lines carry cumulative counters; the newest one IS
+            # the summary (plus how many intervals were recorded)
+            serve_events += 1
+            serve = {k: v for k, v in ev.items() if k != "event"}
             continue
         if ev.get("event") != "iteration":
             continue
@@ -403,7 +411,8 @@ def summarize_events(path: str) -> dict:
     return {"iterations": iters, "wall_time": wall, "phases": phases,
             "recompiles": recompiles, "peak_hbm_bytes": peak_hbm,
             "total_leaves": leaves, "total_split_gain": gain,
-            "last_eval": last_eval, "faults": faults, "ingest": ingest}
+            "last_eval": last_eval, "faults": faults, "ingest": ingest,
+            "serve": serve, "serve_events": serve_events}
 
 
 def render_stats_table(summary: dict) -> str:
@@ -423,6 +432,20 @@ def render_stats_table(summary: dict) -> str:
             f"{ing.get('chunk_rows', 0)} "
             f"(pass1 {ing.get('pass1_s', 0.0):.3f} s, "
             f"pass2 {ing.get('pass2_s', 0.0):.3f} s)")
+    srv = summary.get("serve")
+    if srv:
+        p50 = srv.get("p50_ms")
+        p99 = srv.get("p99_ms")
+        rc = srv.get("recompiles") or {}
+        lines.append(
+            f"serve                : {srv.get('requests_total', 0)} req"
+            f" / {srv.get('rows_total', 0)} rows in "
+            f"{summary.get('serve_events', 0)} interval(s), last qps "
+            f"{srv.get('qps', 0):g}, p50 "
+            f"{'n/a' if p50 is None else '%g ms' % p50}, p99 "
+            f"{'n/a' if p99 is None else '%g ms' % p99}, swaps "
+            f"{srv.get('swaps_total', 0)}, recompiles "
+            f"{rc.get('total', 0)}, model {srv.get('model', '?')}")
     lines.append(f"leaves grown         : {summary['total_leaves']}")
     lines.append(f"split gain sum       : {summary['total_split_gain']:g}")
     faults = summary.get("faults") or {}

@@ -20,10 +20,17 @@ from jax import lax
 from .models.tree import Tree
 from .ops.predict import StackedTrees, predict_leaf_raw
 
-__all__ = ["predict_any", "stack_trees"]
+__all__ = ["predict_any", "stack_trees", "convert_raw_scores"]
 
 
-def stack_trees(trees: List[Tree], dtype=jnp.float32) -> StackedTrees:
+def stack_trees(trees: List[Tree], dtype=jnp.float32,
+                device: bool = True) -> StackedTrees:
+    """Stack a forest into SoA arrays (leading axis = tree index).
+
+    ``device=False`` returns host (numpy) arrays in their final
+    dtypes — the serving compiler stages the new model on the host so
+    the upload can donate the OLD model's device buffers instead of
+    holding two forests in HBM (serve/compile.py swap protocol)."""
     T = len(trees)
     max_nodes = max((t.num_nodes for t in trees), default=0)
     max_nodes = max(max_nodes, 1)
@@ -96,10 +103,10 @@ def stack_trees(trees: List[Tree], dtype=jnp.float32) -> StackedTrees:
                 # constant tree inside a linear forest: emulate with a
                 # zero-feature linear model
                 lconst[i, : t.num_leaves] = t.leaf_value
-        lin_args = dict(lin_const=jnp.asarray(lconst, dtype),
-                        lin_nfeat=jnp.asarray(lnf),
-                        lin_feats=jnp.asarray(lfe),
-                        lin_coef=jnp.asarray(lco, dtype))
+        lin_args = dict(lin_const=np.asarray(lconst, dtype),
+                        lin_nfeat=lnf,
+                        lin_feats=lfe,
+                        lin_coef=np.asarray(lco, dtype))
 
     # f32-safe thresholds: round DOWN to the nearest f32 so that any
     # f32-representable feature value keeps its training-time side of the
@@ -110,19 +117,22 @@ def stack_trees(trees: List[Tree], dtype=jnp.float32) -> StackedTrees:
         bad = thr32.astype(np.float64) > thr
         thr32[bad] = np.nextafter(thr32[bad], np.float32(-np.inf))
         thr = thr32
-    return StackedTrees(
-        split_feature=jnp.asarray(sf),
-        threshold=jnp.asarray(thr, dtype),
-        threshold_bin=jnp.asarray(tb),
-        default_left=jnp.asarray(dl),
-        missing_type=jnp.asarray(mt),
-        is_categorical=jnp.asarray(ic),
-        cat_bitset=jnp.asarray(bits),
-        left_child=jnp.asarray(lc),
-        right_child=jnp.asarray(rc),
-        leaf_value=jnp.asarray(lv, dtype),
+    stacked = StackedTrees(
+        split_feature=sf,
+        threshold=np.asarray(thr, dtype),
+        threshold_bin=tb,
+        default_left=dl,
+        missing_type=mt,
+        is_categorical=ic,
+        cat_bitset=bits,
+        left_child=lc,
+        right_child=rc,
+        leaf_value=np.asarray(lv, dtype),
         **lin_args,
     )
+    if device:
+        stacked = jax.tree_util.tree_map(jnp.asarray, stacked)
+    return stacked
 
 
 def _extract_matrix(booster, data) -> np.ndarray:
@@ -200,10 +210,9 @@ def predict_any(booster, data, start_iteration: int = 0,
         out = np.zeros((n, K), np.float64)
         return out[:, 0] if K == 1 else out
 
-    stacked = stack_trees(sel)
-    Xd = jnp.asarray(X, jnp.float32)
-
     if pred_leaf:
+        stacked = stack_trees(sel)
+        Xd = jnp.asarray(X, jnp.float32)
         leaves = _predict_leaves_jit(stacked, Xd, len(sel))
         return np.asarray(leaves, np.int32)
 
@@ -215,13 +224,25 @@ def predict_any(booster, data, start_iteration: int = 0,
     obj_name = (booster._objective_str or "none").split()[0]
     es_ok = obj_name in ("binary", "multiclass", "multiclassova",
                          "softmax", "lambdarank", "rank_xendcg")
-    if pred_early_stop and es_ok and not booster._avg_output:
+    use_es = pred_early_stop and es_ok and not booster._avg_output
+    cf = getattr(booster, "_compiled_forest", None)
+    if cf is not None and not use_es and cf.matches(lo, hi, len(trees)):
+        # the shape-bucketed compiled path (serve/compile.py): the
+        # forest is already stacked on device, the batch pads to its
+        # power-of-two bucket, and ad-hoc batch sizes never recompile
+        out = cf.predict_raw(X)               # [n, K] f64
+    elif use_es:
+        stacked = stack_trees(sel)
+        Xd = jnp.asarray(X, jnp.float32)
         scores = _predict_scores_early_stop(
             stacked, Xd, len(sel), K, max(1, pred_early_stop_freq),
             pred_early_stop_margin)
+        out = np.asarray(scores, np.float64)  # [n, K]
     else:
+        stacked = stack_trees(sel)
+        Xd = jnp.asarray(X, jnp.float32)
         scores = _predict_scores_jit(stacked, Xd, len(sel), K)
-    out = np.asarray(scores, np.float64)  # [n, K]
+        out = np.asarray(scores, np.float64)  # [n, K]
 
     if booster._avg_output:
         # random forest: leaves are stored unscaled (reference rf.hpp /
@@ -314,9 +335,16 @@ def _predict_scores_early_stop(stacked, X, T, K, freq, margin):
 
 
 def _convert_output(booster, out: np.ndarray) -> np.ndarray:
+    return convert_raw_scores(booster._objective_str, out)
+
+
+def convert_raw_scores(objective_str: Optional[str],
+                       out: np.ndarray) -> np.ndarray:
     """Objective-specific output transform (ConvertOutput analog), driven
-    by the objective string stored in the model header."""
-    obj = (booster._objective_str or "none").split()
+    by the objective string stored in the model header. Shared by the
+    library predict path and the serving daemon (serve/), which applies
+    it host-side after the compiled raw-score program."""
+    obj = (objective_str or "none").split()
     name = obj[0] if obj else "none"
     kv = dict(t.split(":", 1) for t in obj[1:] if ":" in t)
     flags = {t for t in obj[1:] if ":" not in t}

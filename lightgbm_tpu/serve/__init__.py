@@ -1,0 +1,43 @@
+"""Production inference serving (docs/SERVING.md).
+
+The trained-model half of the north star: a forest is *compiled* once
+into tensorized SoA device arrays with one jitted batch predictor
+(:mod:`~lightgbm_tpu.serve.compile`), requests are micro-batched into
+power-of-two row buckets so arbitrary batch sizes never recompile
+(:mod:`~lightgbm_tpu.serve.batcher`), and ``python -m lightgbm_tpu
+serve <model>`` runs the JSON-lines daemon with checkpoint-directory
+hot model swap and ``{"event": "serve"}`` telemetry
+(:mod:`~lightgbm_tpu.serve.daemon`).
+
+This ``__init__`` is PEP-562 lazy like the package root: the daemon's
+CLI parse/--help path (dispatched jax-free in ``__main__``) imports
+``serve.daemon`` through here, and jax must only load once a model is
+actually being compiled.
+"""
+
+from __future__ import annotations
+
+_LAZY = {
+    "CompiledForest": "compile", "compile_forest": "compile",
+    "bucket_rows": "compile",
+    "MicroBatcher": "batcher", "QueueFullError": "batcher",
+    "main": "daemon", "handle_request": "daemon", "ServeState": "daemon",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    mod = importlib.import_module(f".{target}", __name__)
+    value = getattr(mod, name)
+    globals()[name] = value
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,631 @@
+"""``python -m lightgbm_tpu serve <model>``: the inference daemon.
+
+A stdlib-socket JSON-lines server over one compiled forest
+(serve/compile.py) and one micro-batcher (serve/batcher.py):
+
+- **Protocol** (one JSON object per line, one JSON reply per line)::
+
+      {"rows": [[...], ...]}            -> {"predictions": [...], ...}
+      {"rows": [...], "raw": true}      -> raw scores, no objective
+                                           transform
+      {"cmd": "ping"}                   -> {"ok": true, "model": ...,
+                                            "pid": ...}
+      {"cmd": "stats"}                  -> queue/latency/model snapshot
+      {"cmd": "shutdown"}               -> stops the daemon (testing /
+                                           drains first)
+
+- **Hot model swap**: ``--watch-dir`` polls a directory for the newest
+  model artifact — ``ckpt_*.npz`` training snapshots
+  (resilience/checkpoint.py) or ``*.txt`` model files, both written
+  via the same-dir-tmp + ``os.replace`` atomic convention
+  (utils/atomic.py) — compiles it off the serving path, and swaps it
+  into the batcher. In-flight requests finish on the model they
+  started with; the old forest's HBM is donated to the new upload.
+
+- **Telemetry**: ``{"event": "serve"}`` JSONL lines every
+  ``--stats-interval`` seconds (QPS, queue depth, p50/p99 latency,
+  recompile counter, HBM gauges, swap count) to ``--telemetry`` or
+  ``$LIGHTGBM_TPU_TELEMETRY``; ``python -m lightgbm_tpu stats`` folds
+  them into a serve summary row.
+
+- **Multi-replica**: under ``python -m lightgbm_tpu launch N -- python
+  -m lightgbm_tpu serve ...`` each rank serves on ``--port + rank``
+  and the supervisor restarts the world when a replica dies
+  (docs/SERVING.md).
+
+This module's import surface and its CLI parse path (``--help``,
+missing-model errors) are jax-free — the dispatch in ``__main__`` runs
+before the training CLI loads, and jax is only imported once a model
+is actually loaded and compiled (proved by a subprocess test, like
+``lint``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socketserver
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.log import log_info, log_warning
+
+__all__ = ["main", "build_parser", "handle_request", "ServeState"]
+
+
+# ---------------------------------------------------------------------
+# serving state (model + batcher + telemetry), shared across the
+# request-handler, watcher and stats threads
+# ---------------------------------------------------------------------
+
+class ServeState:
+    """Everything the handler/watcher/stats threads share.
+
+    Threading contract (tpulint TPL006/TPL008 over serve/): mutable
+    fields are only touched under ``self._lock``; model compilation
+    and jax dispatch always happen outside it.
+    """
+
+    def __init__(self, batcher, model_id: str, model_source: str,
+                 registry=None, telemetry_path: Optional[str] = None):
+        from ..obs import RecompileWatcher
+        from ..obs.registry import registry as global_registry
+        self.batcher = batcher
+        self.registry = registry if registry is not None \
+            else global_registry
+        self._lock = threading.Lock()
+        # ---- guarded by self._lock ----
+        self._model_id = model_id
+        self._model_source = model_source
+        self._swap_failures = 0
+        self._last_stats: Dict[str, Any] = {}
+        self._telemetry_file = None
+        self.shutdown_event = threading.Event()
+        self._t0 = time.monotonic()
+        self._watcher = RecompileWatcher()
+        if telemetry_path:
+            try:
+                dirname = os.path.dirname(os.path.abspath(
+                    telemetry_path))
+                os.makedirs(dirname, exist_ok=True)
+                self._telemetry_file = open(telemetry_path, "a",
+                                            encoding="utf-8")
+            except OSError as e:
+                log_warning(f"serve: cannot open telemetry path "
+                            f"{telemetry_path!r} ({e}); serve events "
+                            "will not be written")
+
+    # -- model identity ------------------------------------------------
+    def model_id(self) -> str:
+        with self._lock:
+            return self._model_id
+
+    def model_source(self) -> str:
+        with self._lock:
+            return self._model_source
+
+    def note_swap(self, model_id: str, source: str) -> None:
+        with self._lock:
+            self._model_id = model_id
+            self._model_source = source
+        self.registry.counter("serve_swaps").inc()
+
+    def note_swap_failure(self) -> None:
+        with self._lock:
+            self._swap_failures += 1
+        self.registry.counter("serve_swap_failures").inc()
+
+    def request_shutdown(self) -> None:
+        self.shutdown_event.set()
+
+    # -- telemetry -----------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        """The ``stats`` protocol reply / serve-event payload.
+
+        Rates cover the window since the PREVIOUS stats() call by any
+        consumer. The rate baseline, the recompile watcher (whose
+        ``delta()`` mutates its own fields), and the model metadata
+        are all read-modify-written inside ONE locked section —
+        concurrent pollers (the stats loop + protocol clients) must
+        not double-count a window or tear the watcher. The device
+        queries stay outside the lock (TPL006)."""
+        from ..obs import device_memory_stats
+        snap = self.batcher.stats()
+        hbm = device_memory_stats()         # jax query outside the lock
+        with self._lock:
+            model_id = self._model_id
+            source = self._model_source
+            failures = self._swap_failures
+            last = dict(self._last_stats)
+            uptime = time.monotonic() - self._t0
+            recompiles = {"delta": self._watcher.delta(),
+                          "total": self._watcher.total}
+            self._last_stats = {"uptime_s": uptime,
+                                "requests_total": snap["requests_total"],
+                                "rows_total": snap["rows_total"]}
+        dt = uptime - last.get("uptime_s", 0.0)
+        dreq = snap["requests_total"] - last.get("requests_total", 0)
+        drows = snap["rows_total"] - last.get("rows_total", 0)
+        out = dict(snap)
+        out["model"] = model_id
+        out["model_source"] = source
+        out["swap_failures"] = failures
+        out["uptime_s"] = round(uptime, 3)
+        out["qps"] = round(dreq / dt, 3) if dt > 0 else 0.0
+        out["rows_per_sec"] = round(drows / dt, 3) if dt > 0 else 0.0
+        out["recompiles"] = recompiles
+        out["hbm"] = hbm
+        gauge = self.registry.gauge("serve_queue_depth_rows")
+        gauge.set(snap["queue_depth_rows"])
+        return out
+
+    def emit_serve_event(self) -> None:
+        """One ``{"event": "serve"}`` JSONL line (degrades like the
+        training recorder: an unwritable file stops the stream, never
+        serving)."""
+        payload = {"event": "serve", **self.stats()}
+        with self._lock:
+            fh = self._telemetry_file
+            if fh is None:
+                return
+            try:
+                fh.write(json.dumps(payload) + "\n")
+                fh.flush()
+            except OSError as e:
+                log_warning(f"serve: telemetry write failed ({e}); "
+                            "stopping the event stream")
+                try:
+                    fh.close()
+                except OSError:
+                    pass
+                self._telemetry_file = None
+
+    def close(self) -> None:
+        self.request_shutdown()
+        self.batcher.close()
+        with self._lock:
+            fh, self._telemetry_file = self._telemetry_file, None
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------
+# request handling (pure function over ServeState: unit-testable
+# without sockets)
+# ---------------------------------------------------------------------
+
+def handle_request(obj: Any, state: ServeState) -> Dict[str, Any]:
+    """One protocol request -> one reply object."""
+    if not isinstance(obj, dict):
+        return {"error": "request must be a JSON object"}
+    if "cmd" in obj:
+        cmd = obj["cmd"]
+        if cmd == "ping":
+            return {"ok": True, "model": state.model_id(),
+                    "pid": os.getpid()}
+        if cmd == "stats":
+            return {"ok": True, **state.stats()}
+        if cmd == "shutdown":
+            state.request_shutdown()
+            return {"ok": True, "shutting_down": True}
+        return {"error": f"unknown cmd: {cmd!r}"}
+    rows = obj.get("rows", obj.get("features"))
+    if rows is None:
+        return {"error": "expected 'rows' (list of feature rows), "
+                         "'features' (one row) or 'cmd'"}
+    import numpy as np
+    try:
+        X = np.asarray(rows, np.float32)
+    except (TypeError, ValueError) as e:
+        return {"error": f"rows are not a numeric matrix: {e}"}
+    if X.ndim == 1:
+        X = X[None, :]
+    if X.ndim != 2 or X.shape[0] == 0:
+        return {"error": f"rows must be [n, n_features], got shape "
+                         f"{X.shape}"}
+    from .batcher import QueueFullError
+    try:
+        fut = state.batcher.submit(X)
+    except QueueFullError as e:
+        return {"error": str(e), "overloaded": True}
+    except (ValueError, RuntimeError) as e:
+        return {"error": str(e)}
+    try:
+        raw_scores = fut.result()
+    except Exception as e:                       # batch-level failure
+        return {"error": f"prediction failed: {e}"}
+    # finalize with the forest that PRODUCED the scores (stamped on
+    # the future by the batcher worker): a hot swap completing between
+    # dispatch and here must not apply the new model's objective
+    # transform / rf averaging / class count to the old model's raw
+    # scores
+    forest = getattr(fut, "serving_forest", None)
+    if forest is None:
+        forest = state.batcher._current_forest()
+    out = forest.finalize(raw_scores,
+                          raw_score=bool(obj.get("raw", False)))
+    return {"predictions": out.tolist(), "n": int(X.shape[0]),
+            "model": state.model_id()}
+
+
+# ---------------------------------------------------------------------
+# socket server
+# ---------------------------------------------------------------------
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        state: ServeState = self.server.state  # type: ignore[attr-defined]
+        for raw in self.rfile:
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line.decode("utf-8"))
+            except (UnicodeDecodeError, ValueError):
+                resp = {"error": "malformed JSON line"}
+            else:
+                resp = handle_request(obj, state)
+            try:
+                self.wfile.write((json.dumps(resp) + "\n")
+                                 .encode("utf-8"))
+                self.wfile.flush()
+            except OSError:
+                return                      # client went away mid-reply
+            if resp.get("shutting_down"):
+                return
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True       # supervised restarts rebind fast
+    daemon_threads = True
+
+
+# ---------------------------------------------------------------------
+# model loading + watching
+# ---------------------------------------------------------------------
+
+def _find_model_artifact(directory: str) \
+        -> Optional[Tuple[float, str]]:
+    """Newest model artifact in ``directory``: (mtime, path) over
+    ``ckpt_*.npz`` snapshots and ``*.txt`` model files."""
+    best: Optional[Tuple[float, str]] = None
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    for name in names:
+        if not ((name.startswith("ckpt_") and name.endswith(".npz"))
+                or name.endswith(".txt")):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue
+        key = (mtime, path)
+        if best is None or key > best:
+            best = key
+    return best
+
+
+def _load_booster(path: str):
+    """A Booster from either a model text file or a training
+    checkpoint snapshot (the daemon serves straight from the
+    checkpoint directory the trainer writes into). A file that parses
+    to ZERO trees is rejected — the lenient model-text parser would
+    otherwise let any stray .txt in a watch dir replace a good model
+    with one that predicts constants."""
+    from ..basic import Booster, LightGBMError
+    if path.endswith(".npz"):
+        from ..resilience.checkpoint import load_snapshot
+        snap = load_snapshot(path)
+        booster = Booster(model_str=snap["model_str"])
+    else:
+        booster = Booster(model_file=path)
+    if not booster._models:
+        raise LightGBMError(f"{path}: parsed to a model with no trees")
+    return booster
+
+
+def _artifact_key(path: str) -> Tuple[str, float, int]:
+    st = os.stat(path)
+    return (path, st.st_mtime, st.st_size)
+
+
+class _Watcher:
+    """Polls ``watch_dir`` and hot-swaps the newest model artifact
+    into the batcher. Runs on its own thread; compilation happens here,
+    off the serving path, and the swap itself is one locked pointer
+    exchange inside the batcher."""
+
+    def __init__(self, state: ServeState, watch_dir: str,
+                 interval_s: float, compile_kwargs: Dict[str, Any],
+                 current_key: Optional[Tuple[str, float, int]],
+                 warmup_rows: Optional[int]):
+        self.state = state
+        self.watch_dir = watch_dir
+        self.interval_s = max(0.05, float(interval_s))
+        self.compile_kwargs = dict(compile_kwargs)
+        self.warmup_rows = warmup_rows
+        self._last_key = current_key
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="lightgbm-tpu-serve-watcher")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self.state.shutdown_event.wait(self.interval_s):
+            self.poll_once()
+
+    def poll_once(self) -> bool:
+        """One poll; True when a swap happened (tests call this
+        directly for determinism)."""
+        found = _find_model_artifact(self.watch_dir)
+        if found is None:
+            return False
+        _, path = found
+        try:
+            key = _artifact_key(path)
+        except OSError:
+            return False
+        # self._last_key is only touched on this thread (and the
+        # constructor, which runs before it starts)
+        if key == self._last_key:
+            return False
+        self._last_key = key
+        try:
+            booster = _load_booster(path)
+            from .compile import compile_forest
+            old = self.state.batcher._current_forest()
+            # stage HOST-side on this thread (no HBM, no serving
+            # pause); the worker-side attach below does the upload
+            staged = compile_forest(booster, stage=True,
+                                    **self.compile_kwargs)
+            if staged.n_features != old.n_features:
+                raise ValueError(
+                    f"new model expects {staged.n_features} features, "
+                    f"the served one {old.n_features} — clients would "
+                    "break; refusing the swap")
+            # the swap rides the request queue: the worker applies it
+            # between batches, where the old forest is provably idle,
+            # so attach() can DONATE its device buffers field-by-field
+            # to the new upload — the transient HBM overhead is one
+            # field, never a second resident forest
+            fut = self.state.batcher.swap_deferred(
+                lambda old_forest: staged.attach(reuse=old_forest))
+            try:
+                forest = fut.result(timeout=300)
+            except Exception:
+                # a swap whose outcome we stop observing must never
+                # apply later with the served identity unreported —
+                # cancel it; if it raced in anyway, take its result
+                if not fut.cancel() and fut.done() \
+                        and fut.exception() is None:
+                    forest = fut.result()
+                else:
+                    raise
+        except Exception as e:
+            # a half-trained/corrupt artifact must never take down the
+            # old model; atomic writers make this rare, not impossible
+            log_warning(f"serve: hot swap from {path!r} failed ({e}); "
+                        "keeping the current model")
+            self.state.note_swap_failure()
+            return False
+        # identity updates the moment the new model SERVES; warmup is
+        # an optimization and its failure is not a failed swap (the
+        # buckets just compile lazily on traffic)
+        self.state.note_swap(forest.model_id, path)
+        log_info(f"serve: hot-swapped model from {path} "
+                 f"(id {forest.model_id})")
+        if self.warmup_rows != 0:
+            try:
+                forest.warmup(self.warmup_rows)
+            except Exception as e:
+                log_warning(f"serve: post-swap warmup failed ({e}); "
+                            "buckets will compile on demand")
+        return True
+
+
+class _StatsLoop:
+    """Periodic ``{"event": "serve"}`` emitter."""
+
+    def __init__(self, state: ServeState, interval_s: float):
+        self.state = state
+        self.interval_s = max(0.1, float(interval_s))
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="lightgbm-tpu-serve-stats")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self.state.shutdown_event.wait(self.interval_s):
+            self.state.emit_serve_event()
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+_HELP_EPILOG = """\
+The model argument is a model text file, a ckpt_*.npz training
+snapshot, or a directory (the newest artifact inside is served and the
+directory is watched for hot swaps unless --watch-dir overrides it).
+Under `python -m lightgbm_tpu launch N -- python -m lightgbm_tpu serve
+...` each rank serves on --port + LIGHTGBM_TPU_RANK and the supervisor
+restarts dead replicas. Protocol, swap semantics and telemetry fields:
+docs/SERVING.md.
+
+exit codes:
+  0  clean shutdown (protocol `shutdown` command or SIGINT)
+  1  bad model path / unservable model / socket bind failure
+  2  bad command line
+"""
+
+
+def build_parser() -> argparse.ArgumentParser:
+    # defaults come from the Config dataclass (the single source of
+    # truth docs/PARAMETERS.md renders); importing it is jax-free
+    from ..config import Config
+    p = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu serve",
+        description="JSON-lines inference daemon over a compiled "
+                    "forest: shape-bucketed batching (no per-shape "
+                    "recompiles), bounded-window micro-batching, "
+                    "atomic hot model swap, serve telemetry.",
+        epilog=_HELP_EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("model",
+                   help="model .txt / ckpt_*.npz snapshot / directory")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="bind address (default 127.0.0.1)")
+    p.add_argument("--port", type=int, default=8799,
+                   help="base port; a launch-supervised replica adds "
+                        "its rank (default 8799, 0 = ephemeral)")
+    p.add_argument("--watch-dir", default=None,
+                   help="directory to poll for newer model artifacts "
+                        "(atomic hot swap; default: the model "
+                        "directory when MODEL is a directory)")
+    p.add_argument("--watch-interval", type=float,
+                   default=Config.serve_watch_interval_sec,
+                   help="watch-dir poll period in seconds")
+    p.add_argument("--telemetry", default=None,
+                   help="JSONL path for {\"event\": \"serve\"} lines "
+                        "(default: $LIGHTGBM_TPU_TELEMETRY)")
+    p.add_argument("--stats-interval", type=float,
+                   default=Config.serve_stats_interval_sec,
+                   help="seconds between serve telemetry events")
+    p.add_argument("--window-ms", type=float,
+                   default=Config.serve_batch_window_ms,
+                   help="micro-batching window in milliseconds")
+    p.add_argument("--max-batch-rows", type=int,
+                   default=Config.serve_max_batch_rows,
+                   help="largest device batch (power of two)")
+    p.add_argument("--min-bucket-rows", type=int,
+                   default=Config.serve_min_bucket_rows,
+                   help="smallest row bucket (power of two)")
+    p.add_argument("--queue-rows", type=int,
+                   default=Config.serve_queue_rows,
+                   help="pending-row budget before submits are "
+                        "rejected (backpressure)")
+    p.add_argument("--warmup-rows", type=int, default=None,
+                   help="pre-compile buckets up to this many rows at "
+                        "startup (default: all buckets; 0 disables)")
+    p.add_argument("--num-iteration", type=int, default=-1,
+                   help="serve only the first N boosting rounds "
+                        "(default: all)")
+    return p
+
+
+def _resolve_model(args) -> Tuple[str, Optional[str]]:
+    """-> (model path, effective watch dir). jax-free."""
+    model = args.model
+    watch_dir = args.watch_dir
+    if os.path.isdir(model):
+        if watch_dir is None:
+            watch_dir = model
+        found = _find_model_artifact(model)
+        if found is None:
+            raise FileNotFoundError(
+                f"no model artifact (ckpt_*.npz or *.txt) in "
+                f"directory {model!r}")
+        model = found[1]
+    elif not os.path.exists(model):
+        raise FileNotFoundError(f"model file not found: {model!r}")
+    if watch_dir is not None and not os.path.isdir(watch_dir):
+        raise FileNotFoundError(
+            f"--watch-dir is not a directory: {watch_dir!r}")
+    return model, watch_dir
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        args = build_parser().parse_args(argv)
+    except SystemExit as e:       # argparse --help (0) / usage error (2)
+        return int(e.code or 0)
+    try:
+        model_path, watch_dir = _resolve_model(args)
+    except (FileNotFoundError, OSError) as e:
+        print(f"[LightGBM-TPU] [Fatal] {e}", file=sys.stderr)
+        return 1
+    # ---- everything below may import jax ----
+    rank = int(os.environ.get("LIGHTGBM_TPU_RANK", "0") or 0)
+    port = args.port + rank if args.port else 0
+    telemetry_path = args.telemetry \
+        or os.environ.get("LIGHTGBM_TPU_TELEMETRY")
+    if telemetry_path and rank:
+        telemetry_path = f"{telemetry_path}.rank{rank}"
+    try:
+        # key the watch state to the artifact BEFORE loading it (and
+        # inside the try: checkpoint rotation can delete/replace the
+        # file at any point): stat-then-load can at worst re-swap to
+        # identical content on the first poll, while load-then-stat
+        # would suppress a legitimate first swap forever
+        watch_key = _artifact_key(model_path)
+        booster = _load_booster(model_path)
+        from .batcher import MicroBatcher
+        from .compile import compile_forest
+        compile_kwargs = dict(
+            num_iteration=args.num_iteration,
+            min_bucket=args.min_bucket_rows,
+            max_batch_rows=args.max_batch_rows)
+        forest = compile_forest(booster, **compile_kwargs)
+        if args.warmup_rows != 0:
+            forest.warmup(args.warmup_rows)
+        # inside the try: bad --window-ms/--queue-rows/bucket values
+        # must exit with the documented [Fatal] line, not a traceback
+        batcher = MicroBatcher(forest, batch_window_ms=args.window_ms,
+                               max_batch_rows=args.max_batch_rows,
+                               queue_max_rows=args.queue_rows)
+    except Exception as e:
+        print(f"[LightGBM-TPU] [Fatal] cannot serve {model_path!r}: "
+              f"{e}", file=sys.stderr)
+        return 1
+    state = ServeState(batcher, forest.model_id, model_path,
+                       telemetry_path=telemetry_path)
+    try:
+        server = _Server((args.host, port), _Handler)
+    except OSError as e:
+        print(f"[LightGBM-TPU] [Fatal] cannot bind "
+              f"{args.host}:{port}: {e}", file=sys.stderr)
+        state.close()
+        return 1
+    server.state = state                     # type: ignore[attr-defined]
+    bound_port = server.server_address[1]
+    if watch_dir:
+        _Watcher(state, watch_dir, args.watch_interval, compile_kwargs,
+                 watch_key, args.warmup_rows).start()
+    _StatsLoop(state, args.stats_interval).start()
+    ready = {"event": "serve_ready", "host": args.host,
+             "port": bound_port, "pid": os.getpid(), "rank": rank,
+             "model": forest.model_id, "model_source": model_path,
+             "watch_dir": watch_dir,
+             "buckets": forest.buckets()}
+    print(json.dumps(ready), flush=True)
+    log_info(f"serve: listening on {args.host}:{bound_port} "
+             f"(model {forest.model_id}, "
+             f"{forest.num_trees} trees, K={forest.K})")
+    server_thread = threading.Thread(target=server.serve_forever,
+                                     kwargs={"poll_interval": 0.2},
+                                     daemon=True,
+                                     name="lightgbm-tpu-serve-accept")
+    server_thread.start()
+    try:
+        state.shutdown_event.wait()
+    except KeyboardInterrupt:
+        pass
+    state.emit_serve_event()                 # final snapshot
+    server.shutdown()
+    server.server_close()
+    state.close()
+    log_info("serve: shut down cleanly")
+    return 0

@@ -1,0 +1,50 @@
+# tpulint fixture: TPL008 negative — the same micro-batcher as
+# serve/tpl008_pos.py with every worker/caller-shared field guarded by
+# one common lock (proved on the lock-acquisition CFG), the request
+# handoff on a Queue (sync primitives are exempt), and the jax-side
+# dispatch outside the lock. No EXPECT lines.
+import queue
+import threading
+
+_inflight = []
+_inflight_lock = threading.Lock()
+
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = queue.Queue()
+        self.pending_rows = 0
+        self.requests_total = 0
+        self._worker = threading.Thread(target=self._worker_loop,
+                                        daemon=True)
+        self._worker.start()
+
+    def _worker_loop(self):
+        while True:
+            req = self._queue.get()
+            with self._lock:
+                self.pending_rows = 0
+                self.requests_total += 1
+            req.run()       # dispatch outside the lock (TPL006 shape)
+
+    def submit(self, n):
+        with self._lock:
+            self.pending_rows += n
+            return self.pending_rows
+
+    def stats(self):
+        with self._lock:
+            return {"pending": self.pending_rows,
+                    "requests": self.requests_total}
+
+
+def _drain_worker():
+    with _inflight_lock:
+        _inflight.clear()
+
+
+def start_drain():
+    threading.Thread(target=_drain_worker).start()
+    with _inflight_lock:
+        return list(_inflight)

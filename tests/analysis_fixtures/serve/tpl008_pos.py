@@ -1,0 +1,42 @@
+# tpulint fixture: TPL008 positive — a micro-batcher whose worker
+# thread mutates queue/latency bookkeeping no lock guards. This is the
+# "delete the lock inside serve/batcher.py" acceptance shape:
+# serve/tpl008_neg.py is the same batcher WITH the common lock, and
+# stripping it must re-surface these findings.
+import threading
+
+_inflight = []        # module-global request book
+
+
+class Batcher:
+    def __init__(self):
+        self.pending_rows = 0
+        self.requests_total = 0
+        self._worker = threading.Thread(target=self._worker_loop,
+                                        daemon=True)
+        self._worker.start()
+
+    def _worker_loop(self):
+        while True:
+            # EXPECT: TPL008
+            self.pending_rows = 0
+            # EXPECT: TPL008
+            self.requests_total += 1
+
+    def submit(self, n):
+        self.pending_rows += n
+        return self.pending_rows
+
+    def stats(self):
+        return {"pending": self.pending_rows,
+                "requests": self.requests_total}
+
+
+def _drain_worker():
+    # EXPECT: TPL008
+    _inflight.clear()
+
+
+def start_drain():
+    threading.Thread(target=_drain_worker).start()
+    return list(_inflight)

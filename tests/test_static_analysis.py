@@ -175,6 +175,7 @@ _FIXTURES = [
     "data/tpl007_pos.py", "data/tpl007_neg.py",
     "obs/tpl008_pos.py", "obs/tpl008_neg.py",
     "obs/tpl008_pragma.py",
+    "serve/tpl008_pos.py", "serve/tpl008_neg.py",
     "tpl009_pos.py", "tpl009_neg.py",
     "tpl010_pos.py", "tpl010_neg.py",
 ]
@@ -475,6 +476,25 @@ def test_stripping_the_watchdog_threadsafe_pragma_fails(tmp_path):
             "shared:box#1") in fids, fids
     assert ("TPL008:resilience/watchdog.py:guarded._run:"
             "shared:box#2") in fids, fids
+
+
+def test_stripping_the_batcher_lock_fails(tmp_path):
+    """Serving acceptance mutation: strip the lock around the batcher
+    worker's queue bookkeeping (serve/batcher.py _run_batch) ->
+    TPL008 names the shared counters submit()/stats() read
+    concurrently."""
+    anchor = ("        with self._lock:\n"
+              "            self._pending_rows -= X.shape[0]\n")
+    res = _lint_mutated(
+        "serve/batcher.py",
+        lambda src: src.replace(
+            anchor,
+            "        if True:\n"
+            "            self._pending_rows -= X.shape[0]\n"),
+        ["TPL008"], tmp_path)
+    fids = [f.fid for f in res.findings]
+    assert ("TPL008:serve/batcher.py:MicroBatcher._run_batch:"
+            "shared:self._pending_rows#1") in fids, fids
 
 
 def test_grow_collective_conds_are_justified():
