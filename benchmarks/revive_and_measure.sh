@@ -1,12 +1,20 @@
 #!/bin/bash
-# Tunnel revival watcher (round 5). Probes the axon TPU tunnel every
+# Tunnel revival watcher (round 6). Probes the axon TPU tunnel every
 # PROBE_INTERVAL seconds; as soon as backend init succeeds, runs the
 # measurement battery in priority order and exits:
-#   1. benchmarks/decompose_iter.py  -> benchmarks/DECOMP_r05.txt
+#   1. benchmarks/decompose_iter.py  -> benchmarks/DECOMP_r06.txt
 #      (per-phase attribution of the 893-vs-392 ms gap AND the full
 #       train_one_iter number, VERDICT r4 #1/#2)
-#   2. bench.py (Higgs 10.5M)        -> benchmarks/BENCH_LOCAL_r05.json
-#   3. bench.py allstate preset 2M   -> benchmarks/BENCH_ALLSTATE_r05.json
+#   2. bench.py (Higgs 10.5M)        -> benchmarks/BENCH_LOCAL_r06.json
+#   3. bench.py allstate preset 2M   -> benchmarks/BENCH_ALLSTATE_r06.json
+#   4. benchmarks/fused_iter_bench.py -> benchmarks/FUSED_r06.txt
+#      (the PENDING pallas flip gate: its fused+pallas arm prints the
+#       FLIP/keep verdict that decides hist_method auto on TPU,
+#       docs/PALLAS.md)
+#   5. benchmarks/quant_bench.py --comms -> benchmarks/COMMS_r06.txt
+#      (f32 vs int16 vs int8 histogram allreduce at the Allstate-wide
+#       shape on 8 devices; its verdict gates hist_comm auto -> int8,
+#       docs/COLLECTIVES.md)
 # Each step is individually time-bounded so a mid-battery tunnel death
 # still leaves earlier results on disk.
 cd "$(dirname "$0")/.." || exit 1
@@ -29,10 +37,10 @@ while :; do
     sleep "$PROBE_INTERVAL"
 done
 
-log "step 1/3: decompose_iter"
+log "step 1/5: decompose_iter"
 timeout 2400 python benchmarks/decompose_iter.py \
-    > benchmarks/DECOMP_r05.txt 2>&1
-log "decompose rc=$? (results in benchmarks/DECOMP_r05.txt)"
+    > benchmarks/DECOMP_r06.txt 2>&1
+log "decompose rc=$? (results in benchmarks/DECOMP_r06.txt)"
 
 # bench.py ALWAYS exits 0 (its supervisor owns the one-JSON-line
 # contract), so success is judged on the JSON itself: a failure
@@ -43,13 +51,23 @@ bench_status() {  # $1 = json file
     else echo NO-OUTPUT; fi
 }
 
-log "step 2/3: full Higgs bench"
+log "step 2/5: full Higgs bench"
 BENCH_DEADLINE=1800 timeout 2000 python bench.py \
-    > benchmarks/BENCH_LOCAL_r05.json 2>benchmarks/BENCH_LOCAL_r05.err
-log "higgs bench $(bench_status benchmarks/BENCH_LOCAL_r05.json): $(cat benchmarks/BENCH_LOCAL_r05.json)"
+    > benchmarks/BENCH_LOCAL_r06.json 2>benchmarks/BENCH_LOCAL_r06.err
+log "higgs bench $(bench_status benchmarks/BENCH_LOCAL_r06.json): $(cat benchmarks/BENCH_LOCAL_r06.json)"
 
-log "step 3/3: allstate preset"
+log "step 3/5: allstate preset"
 BENCH_PRESET=allstate BENCH_DEADLINE=3000 timeout 3200 python bench.py \
-    > benchmarks/BENCH_ALLSTATE_r05.json 2>benchmarks/BENCH_ALLSTATE_r05.err
-log "allstate bench $(bench_status benchmarks/BENCH_ALLSTATE_r05.json): $(cat benchmarks/BENCH_ALLSTATE_r05.json)"
+    > benchmarks/BENCH_ALLSTATE_r06.json 2>benchmarks/BENCH_ALLSTATE_r06.err
+log "allstate bench $(bench_status benchmarks/BENCH_ALLSTATE_r06.json): $(cat benchmarks/BENCH_ALLSTATE_r06.json)"
+
+log "step 4/5: fused_iter_bench (pallas flip gate)"
+timeout 2400 python benchmarks/fused_iter_bench.py \
+    > benchmarks/FUSED_r06.txt 2>&1
+log "fused_iter rc=$? verdict: $(grep -a 'pallas vs mxu' benchmarks/FUSED_r06.txt || echo none)"
+
+log "step 5/5: quant_bench --comms (hist_comm flip gate)"
+timeout 1200 python benchmarks/quant_bench.py --comms \
+    > benchmarks/COMMS_r06.txt 2>&1
+log "comms rc=$? verdict: $(grep -a 'vs f32 allreduce' benchmarks/COMMS_r06.txt || echo none)"
 log "battery done"

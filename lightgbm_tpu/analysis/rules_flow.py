@@ -88,6 +88,15 @@ class CollectiveOrder(Rule):
     id = "TPL007"
     title = "host collective reached in rank-divergent order"
 
+    #: device-collective wrappers from parallel/comms.py: wrapping
+    #: ``lax.psum``/``all_to_all`` in a helper must not blind the lint
+    #: — a quantized-comms reduction reached in rank-divergent host
+    #: order is the same world-desync hazard one level down (the
+    #: traced program itself then differs per rank). Kept as its own
+    #: set so the recognizer-strip mutation test can prove the entry
+    #: is load-bearing.
+    _COMMS_WRAPPERS = frozenset({"hist_allreduce"})
+
     #: direct host-collective entry points (basenames — matches both
     #: resolved package functions and unresolved externals, so fixtures
     #: and the real tree hit the same detector)
@@ -95,7 +104,8 @@ class CollectiveOrder(Rule):
                     "verify_step_consistency", "sync_bin_mappers",
                     "aggregate_phase_snapshot", "process_allgather",
                     "broadcast_one_to_all", "sync_global_devices",
-                    "wait_at_barrier", "assert_equal_per_process"}
+                    "wait_at_barrier",
+                    "assert_equal_per_process"} | _COMMS_WRAPPERS
 
     def run(self, ctx: LintContext) -> Iterator[Finding]:
         reaches = self._reaches_collective(ctx.graph)
@@ -657,6 +667,13 @@ class CollectiveUnderTracedCond(Rule):
     _DEVICE_COLLECTIVES = {"psum", "pmax", "pmin", "pmean", "all_gather",
                            "all_to_all", "ppermute", "pshuffle",
                            "psum_scatter", "pgather"}
+    #: package wrappers that ARE device collectives (parallel/comms.py
+    #: quantized histogram allreduce): recognized directly — spelled
+    #: ``comms.hist_allreduce`` or bare — so wrapping ``lax.psum``
+    #: does not blind this rule even when comms.py itself is outside
+    #: the linted file set (fixtures, --changed slices). The
+    #: callgraph closure still covers in-package spellings.
+    _COMMS_WRAPPERS = frozenset({"hist_allreduce"})
     _COND_NAMES = {"cond", "switch"}
 
     def run(self, ctx: LintContext) -> Iterator[Finding]:
@@ -783,6 +800,10 @@ class CollectiveUnderTracedCond(Rule):
                     and (len(parts) == 1
                          or parts[0] in ("jax", "lax")):
                 return parts[-1], None
+            # comms.hist_allreduce(...) IS a device collective
+            if parts[-1] in self._COMMS_WRAPPERS \
+                    and (len(parts) == 1 or "comms" in parts):
+                return parts[-1], None
             if parts[0] in ("jax", "lax", "jnp", "np", "numpy",
                             "functools"):
                 continue
@@ -841,6 +862,7 @@ class CollectiveUnderTracedCond(Rule):
     def _reaches_device_collective(graph: CallGraph) -> Dict[Key, str]:
         """key -> the device collective it (transitively) dispatches."""
         direct: Dict[Key, str] = {}
+        wrappers = CollectiveUnderTracedCond._COMMS_WRAPPERS
         for scope, facts in graph.facts.items():
             if scope is None:
                 continue
@@ -850,6 +872,12 @@ class CollectiveUnderTracedCond(Rule):
                     if parts[-1] in \
                             CollectiveUnderTracedCond._DEVICE_COLLECTIVES \
                             and parts[0] in ("jax", "lax"):
+                        direct.setdefault(scope, parts[-1])
+                    elif parts[-1] in wrappers \
+                            and (len(parts) == 1 or "comms" in parts):
+                        # same spellings the cond-site recognizer
+                        # accepts (bare from-import included) — the
+                        # transitive map must not be narrower
                         direct.setdefault(scope, parts[-1])
         callers: Dict[Key, Set[Optional[Key]]] = {}
         for scope, facts in graph.facts.items():

@@ -341,7 +341,13 @@ class Config:
     num_iterations: int = 100
     learning_rate: float = 0.1
     num_leaves: int = 31
-    tree_learner: str = "serial"  # serial | feature | data | voting
+    # serial | feature | data | voting | auto. "auto" replaces the
+    # static flag with the payload-model decision (parallel/comms.py
+    # choose_parallel_mode): feature-parallel for replicable data,
+    # data-parallel while one histogram reduction stays cheap at the
+    # chosen hist_comm wire dtype, voting beyond (the reference's
+    # Parallel-Learning-Guide table, measured instead of adjectival).
+    tree_learner: str = "serial"
     num_threads: int = 0
     device_type: str = "tpu"  # cpu | tpu
     seed: Optional[int] = None
@@ -528,6 +534,17 @@ class Config:
     # ---- tpu-specific (new; no reference analog) ----
     num_devices: int = 0  # 0 = use all visible devices for data-parallel
     hist_dtype: str = "float32"  # histogram accumulator dtype
+    # histogram allreduce wire format for distributed training
+    # (parallel/comms.py; docs/COLLECTIVES.md): f32 = exact psum |
+    # int16 / int8 = EQuARX-style blockwise-quantized allreduce with
+    # per-block f32 scales and an error-feedback residual carried
+    # through the growth loop (split decisions stay bit-identical
+    # across ranks; int8 cuts the dominant data-parallel histogram
+    # payload ~4x) | auto = int16 once one f32 histogram reduction
+    # crosses ~1 MiB, exact f32 below. Ignored by serial training,
+    # feature-parallel (no histogram reduction) and quantized-gradient
+    # histograms (already exact int32).
+    hist_comm: str = "f32"
     sharding_axis: str = "data"  # mesh axis name for row sharding
     # histogram build strategy: auto|scatter|mxu|pallas. auto: nibble
     # matmul (MXU) on TPU and scatter-add on CPU; pallas: hand-tiled
@@ -642,8 +659,12 @@ class Config:
         if self.data_sample_strategy not in ("bagging", "goss"):
             raise ValueError(
                 f"Unknown data_sample_strategy: {self.data_sample_strategy}")
-        if self.tree_learner not in ("serial", "feature", "data", "voting"):
+        if self.tree_learner not in ("serial", "feature", "data",
+                                     "voting", "auto"):
             raise ValueError(f"Unknown tree_learner: {self.tree_learner}")
+        if self.hist_comm not in ("f32", "int16", "int8", "auto"):
+            raise ValueError(f"Unknown hist_comm: {self.hist_comm} "
+                             "(expected f32, int16, int8 or auto)")
         if self.monotone_constraints_method not in (
                 "basic", "intermediate", "advanced"):
             raise ValueError(

@@ -330,8 +330,12 @@ class GBDTBooster:
         self.bundle = None
         self._bundle_dev = None
         # single source for the distributed dispatch decision — the
-        # EFB gate below and the mesh setup further down must agree
-        want_dp = (cfg.tree_learner in ("data", "feature", "voting")
+        # EFB gate below and the mesh setup further down must agree.
+        # tree_learner="auto" resolves to a concrete mode inside the
+        # dp_active block (it needs the post-bundle column count and
+        # the world size; parallel/comms.py choose_parallel_mode).
+        want_dp = (cfg.tree_learner in ("data", "feature", "voting",
+                                        "auto")
                    or cfg.num_devices > 1)
         dp_active = want_dp and len(jax.devices()) > 1
         dp_mode = {"feature": "feature",
@@ -408,9 +412,54 @@ class GBDTBooster:
             raise ValueError("CEGB is not supported with multi-device "
                              "training yet")
         if dp_active:
+            from ..parallel import comms
             from ..parallel.data_parallel import make_dp_grow_fn
             from ..parallel.mesh import make_mesh, pad_rows
+            self.mesh = make_mesh(cfg.num_devices)
+            D = int(self.mesh.devices.size)
             mode = dp_mode
+            ncols = int(self.bins_T.shape[0])
+            if cfg.tree_learner == "auto":
+                # payload-adaptive choice (ROADMAP item 2): re-derived
+                # per tree from (F, B, rows, world, wire dtype) — all
+                # static for a given training run, so the per-tree
+                # evaluation constant-folds to one mode; it moves only
+                # when the run's shape does (e.g. a reset_parameter
+                # rebuild). Forced splits exclude voting before
+                # costing (CEGB never reaches here: any multi-device
+                # CEGB run raised above).
+                mode = comms.choose_parallel_mode(
+                    ncols, self.grow_cfg.num_bins, self.n, D,
+                    cfg.hist_comm, cfg.top_k)
+                if mode == "voting" and self.forced is not None:
+                    mode = "data"
+                if mode != "data" and self.grow_cfg.grower != "compact":
+                    # feature/voting replicate rows and gate their
+                    # reductions per-search — only the compact grower
+                    # implements that; level raises and masked would
+                    # psum D identical replicated histograms
+                    mode = "data"
+                from ..utils.log import log_info
+                log_info(
+                    f"tree_learner=auto -> {mode}-parallel "
+                    f"(F={ncols}, B={self.grow_cfg.num_bins}, "
+                    f"rows={self.n}, world={D}, "
+                    f"hist_comm={cfg.hist_comm})")
+            # quantized histogram wire (docs/COLLECTIVES.md): resolve
+            # "auto" against the histogram payload the CHOSEN mode
+            # actually reduces (voting moves the small elected buffer,
+            # not the full [F, B, 2] histogram)
+            wire = comms.resolve_hist_comm(
+                cfg.hist_comm, ncols, self.grow_cfg.num_bins,
+                mode, cfg.top_k)
+            if cfg.use_quantized_grad or mode == "feature":
+                # quantized-gradient training reduces exact int32
+                # histograms and feature-parallel reduces no histogram
+                # at all — the wire never quantizes (the grower pins
+                # it via make_hist_psum_ef(quantize=False)); record
+                # f32 so telemetry reports the wire actually used
+                wire = "f32"
+            self.grow_cfg = self.grow_cfg._replace(hist_comm=wire)
             if mode == "voting" and (self.forced is not None
                                      or self.cegb_enabled):
                 raise ValueError(
@@ -430,8 +479,6 @@ class GBDTBooster:
                     monotone_method="basic")
             self.grow_cfg = self.grow_cfg._replace(
                 parallel_mode=mode, voting_top_k=cfg.top_k)
-            self.mesh = make_mesh(cfg.num_devices)
-            D = int(self.mesh.devices.size)
             # feature-parallel replicates rows; no shard padding needed
             self._pad = 0 if mode == "feature" else pad_rows(self.n, D)
             if self._pad:
@@ -696,6 +743,46 @@ class GBDTBooster:
         else:
             return None
         return {"trees": K, "leaves": leaves, "split_gain_sum": gain}
+
+    def telemetry_comm_stats(self,
+                             leaves: Optional[int] = None
+                             ) -> Optional[Dict[str, object]]:
+        """Per-iteration collective-payload accounting for the
+        telemetry recorder (obs/recorder.py): bytes MODELED from the
+        dtype-aware payload model (parallel/comms.py — the same model
+        ``dryrun_multichip`` validates against the lowered StableHLO),
+        not a wire measurement: one histogram reduction per split plus
+        the root, so reductions == leaves grown — except the level
+        grower's scatter path, which reduces the whole ``[L, F, B, 2]``
+        level batch once per frontier level (modeled as ~log2 levels of
+        a balanced tree, x L slots each). None when training is
+        single-device (no collectives). ``leaves`` lets the recorder
+        reuse the tree stats it already fetched; defaults to the
+        num_leaves budget."""
+        if self.mesh is None:
+            return None
+        from ..parallel import comms
+        g = self.grow_cfg
+        ncols = int(self.bins_T.shape[0])
+        per_reduction = comms.payload_bytes(
+            g.parallel_mode, ncols, g.num_bins, g.hist_comm,
+            g.voting_top_k)
+        if leaves is None:
+            leaves = self.cfg.num_leaves * self.K
+        if g.grower == "level" and g.hist_method == "scatter" \
+                and g.parallel_mode == "data":
+            import math
+            per_tree = max(int(leaves) // max(self.K, 1), 2)
+            levels = max(1, math.ceil(math.log2(per_tree)))
+            n_reductions = self.K * levels * self.cfg.num_leaves
+        else:
+            n_reductions = int(leaves)
+        return {
+            "payload_bytes": int(per_reduction) * n_reductions,
+            "hist_comm": g.hist_comm,
+            "parallel_mode": g.parallel_mode,
+            "world": int(self.mesh.devices.size),
+        }
 
     def preload_models(self, trees: List[Tree],
                        score: Optional[np.ndarray] = None) -> None:

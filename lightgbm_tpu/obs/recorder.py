@@ -34,9 +34,13 @@ from .registry import registry as _global_registry
 __all__ = ["TelemetryRecorder", "ITERATION_EVENT_KEYS",
            "summarize_events", "render_stats_table"]
 
-#: required keys of every iteration event (the JSONL schema contract)
+#: required keys of every iteration event (the JSONL schema contract).
+#: ``comm`` is the collective-payload record of distributed training
+#: (payload bytes from the dtype-aware model in parallel/comms.py,
+#: the hist_comm wire mode, and the parallelism mode chosen) — null
+#: on single-device runs, which move no bytes.
 ITERATION_EVENT_KEYS = ("event", "iteration", "wall_time", "phases",
-                        "recompiles", "hbm", "tree", "eval")
+                        "recompiles", "hbm", "tree", "eval", "comm")
 
 
 class TelemetryRecorder:
@@ -190,6 +194,25 @@ class TelemetryRecorder:
             return {"trees": 0, "leaves": None, "split_gain_sum": None}
         return {"trees": trees, "leaves": leaves, "split_gain_sum": gain}
 
+    def _comm_stats(self, tree: Dict) -> Optional[Dict[str, object]]:
+        """The iteration's collective-payload record from the first
+        distributed engine (models/gbdt.py telemetry_comm_stats),
+        reusing the leaves count already fetched for the tree stats so
+        telemetry adds no second device round-trip. The reuse is only
+        valid when ONE engine is attached — with several, the summed
+        leaves would price one engine's reductions by every engine's
+        growth, so each engine falls back to its own leaf budget. None
+        when every engine trains single-device."""
+        leaves = tree.get("leaves") if len(self._engines) == 1 else None
+        for eng in self._engines:
+            getter = getattr(eng, "telemetry_comm_stats", None)
+            if getter is None:
+                continue
+            stats = getter(leaves)
+            if stats is not None:
+                return stats
+        return None
+
     @staticmethod
     def _eval_dict(evals: Optional[Sequence]) -> Dict[str, float]:
         out: Dict[str, float] = {}
@@ -278,6 +301,7 @@ class TelemetryRecorder:
             "hbm": hbm,
             "tree": tree,
             "eval": self._eval_dict(evals),
+            "comm": self._comm_stats(tree),
         }
         self._feed_registry(event)
         self._drain_fault_events()  # fault lines precede their iteration
@@ -299,6 +323,12 @@ class TelemetryRecorder:
             reg.histogram("tree_leaves").observe(event["tree"]["leaves"])
             reg.histogram("tree_split_gain_sum").observe(
                 event["tree"]["split_gain_sum"])
+        comm = event.get("comm")
+        if comm:
+            reg.counter("comm_bytes",
+                        mode=str(comm["parallel_mode"]),
+                        wire=str(comm["hist_comm"])).inc(
+                comm["payload_bytes"])
 
 
 # ---------------------------------------------------------------------
@@ -345,6 +375,8 @@ def summarize_events(path: str) -> dict:
     ingest: Optional[Dict[str, float]] = None
     serve: Optional[Dict[str, object]] = None
     serve_events = 0
+    comm_bytes = 0
+    comm_last: Optional[Dict[str, object]] = None
 
     def _parse(line: str, is_last: bool) -> Optional[dict]:
         try:
@@ -408,11 +440,15 @@ def summarize_events(path: str) -> dict:
             gain += float(tree.get("split_gain_sum") or 0.0)
         if ev.get("eval"):
             last_eval = ev["eval"]
+        if ev.get("comm"):
+            comm_last = ev["comm"]
+            comm_bytes += int(ev["comm"].get("payload_bytes", 0))
     return {"iterations": iters, "wall_time": wall, "phases": phases,
             "recompiles": recompiles, "peak_hbm_bytes": peak_hbm,
             "total_leaves": leaves, "total_split_gain": gain,
             "last_eval": last_eval, "faults": faults, "ingest": ingest,
-            "serve": serve, "serve_events": serve_events}
+            "serve": serve, "serve_events": serve_events,
+            "comm_bytes": comm_bytes, "comm": comm_last}
 
 
 def render_stats_table(summary: dict) -> str:
@@ -446,6 +482,14 @@ def render_stats_table(summary: dict) -> str:
             f"{'n/a' if p99 is None else '%g ms' % p99}, swaps "
             f"{srv.get('swaps_total', 0)}, recompiles "
             f"{rc.get('total', 0)}, model {srv.get('model', '?')}")
+    comm = summary.get("comm")
+    if comm:
+        cb = summary.get("comm_bytes", 0)
+        lines.append(
+            f"comm payload         : {cb / 2**20:.1f} MiB modeled "
+            f"({comm.get('parallel_mode', '?')}-parallel, "
+            f"hist_comm {comm.get('hist_comm', '?')}, world "
+            f"{comm.get('world', '?')})")
     lines.append(f"leaves grown         : {summary['total_leaves']}")
     lines.append(f"split gain sum       : {summary['total_split_gain']:g}")
     faults = summary.get("faults") or {}

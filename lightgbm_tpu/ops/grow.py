@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..parallel import comms
 from .histogram import (build_histogram, hist_from_rows,
                         hist_from_rows_int, subtract_histogram)
 from .predict import predict_leaf_binned
@@ -155,6 +156,14 @@ class GrowConfig(NamedTuple):
     # training (the benchmark path) drops the column: one less sort
     # operand in every chunk body and no in-bag bookkeeping.
     track_rows: bool = True
+    # histogram allreduce wire format under data-parallel sharding
+    # (parallel/comms.py, EQuARX-style block quantization):
+    # "f32" exact psum | "int16"/"int8" blockwise-quantized exchange
+    # with an error-feedback residual threaded through the growth
+    # loop carry. Scalar/count psums stay f32; quantized-gradient
+    # training (cfg.quantized: exact int32 histograms) and the
+    # feature-parallel mode (no histogram reduction) ignore it.
+    hist_comm: str = "f32"
 
 
 class TreeArrays(NamedTuple):
@@ -237,6 +246,8 @@ class _GrowState(NamedTuple):
     hists: jnp.ndarray      # [L, F, B, 2]
     row_leaf: jnp.ndarray   # [n] i32
     num_splits: jnp.ndarray  # scalar i32
+    comm_ef: jnp.ndarray = ()  # quantized-allreduce error feedback
+                               # (hist_comm int8/int16; comms.py)
 
 
 def _init_tree(L: int, B: int, dtype) -> TreeArrays:
@@ -417,6 +428,9 @@ def _grow_masked_impl(cfg: GrowConfig,
     def psum(x):
         return lax.psum(x, cfg.axis_name) if cfg.axis_name else x
 
+    _, use_ef, hist_psum_ef = comms.make_hist_psum_ef(
+        cfg.axis_name, cfg.hist_comm)
+
     def best_for(hist, sg, sh, sc):
         return find_best_split(hist, sg, sh, sc, feat_num_bins, feat_nan_bin,
                                feature_mask, p, monotone_constraints,
@@ -429,9 +443,10 @@ def _grow_masked_impl(cfg: GrowConfig,
     total_h = psum(jnp.sum(hess * w))
     total_c = psum(jnp.sum(inbag.astype(dtype)))
     all_rows = jnp.ones((n,), jnp.bool_)
-    root_hist = psum(build_histogram(bins_T, grad, hess, row_weight,
-                                     all_rows, B, cfg.hist_method,
-                                     cfg.hist_precision))
+    comm_ef0 = jnp.zeros((F, B, 2), dtype) if use_ef else ()
+    root_hist, comm_ef0 = hist_psum_ef(
+        build_histogram(bins_T, grad, hess, row_weight, all_rows, B,
+                        cfg.hist_method, cfg.hist_precision), comm_ef0)
 
     tree = _init_tree(L, B, dtype)
     tree = tree._replace(
@@ -445,7 +460,8 @@ def _grow_masked_impl(cfg: GrowConfig,
     hists = jnp.zeros((L, F, B, 2), dtype).at[0].set(root_hist)
     state = _GrowState(tree=tree, best=best, hists=hists,
                        row_leaf=jnp.zeros((n,), jnp.int32),
-                       num_splits=jnp.asarray(0, jnp.int32))
+                       num_splits=jnp.asarray(0, jnp.int32),
+                       comm_ef=comm_ef0)
 
     def depth_ok(d):
         if cfg.max_depth <= 0:
@@ -453,7 +469,7 @@ def _grow_masked_impl(cfg: GrowConfig,
         return d < cfg.max_depth
 
     def do_split(state: _GrowState) -> _GrowState:
-        tree, best, hists, row_leaf, ns = state
+        tree, best, hists, row_leaf, ns, comm_ef = state
         leaf = jnp.argmax(best.gain).astype(jnp.int32)
         R = ns + 1  # new (right-child) leaf slot
         f = best.feature[leaf]
@@ -484,9 +500,10 @@ def _grow_masked_impl(cfg: GrowConfig,
         left_smaller = nl_ex <= nr_ex
         small_slot = jnp.where(left_smaller, leaf, R)
         small_mask = row_leaf == small_slot
-        small_hist = psum(build_histogram(bins_T, grad, hess, row_weight,
-                                          small_mask, B, cfg.hist_method,
-                                          cfg.hist_precision))
+        small_hist, comm_ef = hist_psum_ef(
+            build_histogram(bins_T, grad, hess, row_weight, small_mask,
+                            B, cfg.hist_method, cfg.hist_precision),
+            comm_ef)
         parent_hist = hists[leaf]
         big_hist = subtract_histogram(parent_hist, small_hist)
         left_hist = jnp.where(left_smaller, small_hist, big_hist)
@@ -503,7 +520,8 @@ def _grow_masked_impl(cfg: GrowConfig,
         best = best.store(R, rr, can_go_deeper)
 
         return _GrowState(tree=tree, best=best, hists=hists,
-                          row_leaf=row_leaf, num_splits=ns + 1)
+                          row_leaf=row_leaf, num_splits=ns + 1,
+                          comm_ef=comm_ef)
 
     def step(_, state: _GrowState) -> _GrowState:
         can = jnp.max(state.best.gain) > 0.0
@@ -525,6 +543,18 @@ class _LevelState(NamedTuple):
     row_leaf: jnp.ndarray    # [n] i32
     num_splits: jnp.ndarray  # scalar i32
     level: jnp.ndarray       # scalar i32 — depth of the current frontier
+    comm_ef: jnp.ndarray = ()  # error-feedback residual of the
+                               # quantized histogram allreduce
+                               # (hist_comm int8/int16): the scatter
+                               # path reduces the whole [L, F, B, 2]
+                               # level batch in one call, so its EF
+                               # matches that shape; the kernel paths
+                               # reduce one [F, B, 2] child at a time
+                               # and carry a rolling [F, B, 2] buffer
+                               # (the telescope bounds accumulated
+                               # error regardless of leaf attribution
+                               # — see _CompactState.comm_ef — at 1/L
+                               # the HBM of a per-leaf buffer)
 
 
 def _grow_level_impl(cfg: GrowConfig,
@@ -591,6 +621,9 @@ def _grow_level_impl(cfg: GrowConfig,
     def psum(x):
         return lax.psum(x, cfg.axis_name) if cfg.axis_name else x
 
+    _, use_ef, hist_psum_ef = comms.make_hist_psum_ef(
+        cfg.axis_name, cfg.hist_comm)
+
     def best_for(hist, sg, sh, sc):
         return find_best_split(hist, sg, sh, sc, feat_num_bins,
                                feat_nan_bin, feature_mask, p,
@@ -609,9 +642,27 @@ def _grow_level_impl(cfg: GrowConfig,
     total_h = psum(jnp.sum(gh[:, 1]))
     total_c = psum(jnp.sum(inbag.astype(dtype)))
     all_rows = jnp.ones((n,), jnp.bool_)
-    root_hist = psum(build_histogram(bins_T, grad, hess, row_weight,
-                                     all_rows, B, hmethod,
-                                     cfg.hist_precision))
+    comm_ef0 = ()
+    if use_ef:
+        # EF shape follows the reduction the path issues (_LevelState)
+        if hmethod == "scatter":
+            comm_ef0 = jnp.zeros((L, F, B, 2), dtype)
+            root_hist, ef_slot0 = hist_psum_ef(
+                build_histogram(bins_T, grad, hess, row_weight,
+                                all_rows, B, hmethod,
+                                cfg.hist_precision),
+                comm_ef0[0])
+            comm_ef0 = comm_ef0.at[0].set(ef_slot0)
+        else:
+            root_hist, comm_ef0 = hist_psum_ef(
+                build_histogram(bins_T, grad, hess, row_weight,
+                                all_rows, B, hmethod,
+                                cfg.hist_precision),
+                jnp.zeros((F, B, 2), dtype))
+    else:
+        root_hist = psum(build_histogram(bins_T, grad, hess, row_weight,
+                                         all_rows, B, hmethod,
+                                         cfg.hist_precision))
     tree = _init_tree(L, B, dtype)
     tree = tree._replace(
         leaf_value=tree.leaf_value.at[0].set(
@@ -626,11 +677,12 @@ def _grow_level_impl(cfg: GrowConfig,
     state = _LevelState(tree=tree, best=best, hists=hists,
                         row_leaf=jnp.zeros((n,), jnp.int32),
                         num_splits=jnp.asarray(0, jnp.int32),
-                        level=jnp.asarray(0, jnp.int32))
+                        level=jnp.asarray(0, jnp.int32),
+                        comm_ef=comm_ef0)
     slots = jnp.arange(L, dtype=jnp.int32)
 
     def level_step(state: _LevelState) -> _LevelState:
-        tree, best, hists, row_leaf, ns, level = state
+        tree, best, hists, row_leaf, ns, level, comm_ef = state
 
         # -- 1. elect the level's splits, gain-ranked under the budget --
         active = slots < tree.num_leaves
@@ -708,8 +760,9 @@ def _grow_level_impl(cfg: GrowConfig,
                 return carry, h
 
             _, h_f = lax.scan(seg_body, None, bins_T)    # [F, L*B, 2]
-            small_hists = psum(
-                h_f.reshape(F, L, B, 2).transpose(1, 0, 2, 3))
+            small_hists, comm_ef = hist_psum_ef(
+                h_f.reshape(F, L, B, 2).transpose(1, 0, 2, 3),
+                comm_ef)
         else:
             # MXU / Pallas kernels have no segment axis: one masked
             # kernel pass per small child, cond-skipped for idle
@@ -721,21 +774,29 @@ def _grow_level_impl(cfg: GrowConfig,
             # scatter segment pass above. A segment-aware kernel pass
             # (gather the small child's rows first) is the open
             # follow-up for the TPU paths.
-            def hist_one(l, acc):
-                def do(acc):
+            def hist_one(l, carry):
+                def do(carry):
+                    acc, ef = carry
                     mask = row_leaf == small_slot[l]
-                    h = psum(build_histogram(bins_T, grad, hess,
-                                             row_weight, mask, B,
-                                             hmethod,
-                                             cfg.hist_precision))
-                    return lax.dynamic_update_index_in_dim(
+                    h = build_histogram(bins_T, grad, hess, row_weight,
+                                        mask, B, hmethod,
+                                        cfg.hist_precision)
+                    if use_ef:
+                        # rolling EF: each child reduction consumes +
+                        # refills the one [F, B, 2] buffer in sequence
+                        h, ef = hist_psum_ef(h, ef)
+                    else:
+                        h = psum(h)
+                    acc = lax.dynamic_update_index_in_dim(
                         acc, h, small_slot[l], axis=0)
+                    return acc, ef
 
                 # tpulint: replicated-cond splitting is replicated (see the partition sweep)
-                return lax.cond(splitting[l], do, lambda a: a, acc)
+                return lax.cond(splitting[l], do, lambda c: c, carry)
 
-            small_hists = lax.fori_loop(
-                0, L, hist_one, jnp.zeros((L, F, B, 2), dtype))
+            small_hists, comm_ef = lax.fori_loop(
+                0, L, hist_one,
+                (jnp.zeros((L, F, B, 2), dtype), comm_ef))
 
         def sib_one(l, hists):
             def do(hists):
@@ -767,7 +828,7 @@ def _grow_level_impl(cfg: GrowConfig,
                            row_leaf=row_leaf,
                            num_splits=ns + jnp.sum(
                                splitting.astype(jnp.int32)),
-                           level=level + 1)
+                           level=level + 1, comm_ef=comm_ef)
 
     def can_grow(state: _LevelState):
         return (state.num_splits < L - 1) \
@@ -818,6 +879,15 @@ class _CompactState(NamedTuple):
                              # (leaf2slot [L] i32, -1 = evicted;
                              #  slot2leaf [P] i32, -1 = free;
                              #  lru [P] i32 last-use split tick)
+    comm_ef: jnp.ndarray = ()  # [F, B, 2] error-feedback residual of
+                             # the quantized histogram allreduce
+                             # (hist_comm int8/int16; parallel/
+                             # comms.py). One rolling buffer, not
+                             # per-leaf slots: the EF telescope bounds
+                             # accumulated error across the SEQUENCE
+                             # of reductions regardless of leaf
+                             # attribution, at 1/L the memory of the
+                             # histogram cache it rides beside.
     pcache: jnp.ndarray = () # [F, B, 2] prefetched parent histogram of
                              # the NEXT split's leaf (non-pooled only).
                              # Reading the parent from the carry instead
@@ -1006,6 +1076,15 @@ def _grow_compact_impl(cfg: GrowConfig,
             return x
         return lax.psum(x, cfg.axis_name)
 
+    # histogram wire format (parallel/comms.py): quantized exchange
+    # only where a histogram reduction actually happens — data-parallel
+    # float histograms. Quantized-gradient training reduces EXACT int32
+    # histograms (psum stays exact and is already 4x-dense payload-
+    # wise), so it keeps the plain path.
+    qm, use_ef, _psum_ef = comms.make_hist_psum_ef(
+        cfg.axis_name, cfg.hist_comm,
+        quantize=not (fp or vp or cfg.quantized))
+
     def hist_psum(x):
         """Histogram reduction: identity for feature-parallel (every
         device holds all rows, so a local histogram is already global)
@@ -1013,7 +1092,20 @@ def _grow_compact_impl(cfg: GrowConfig,
         per-search over elected features only)."""
         if cfg.axis_name is None or fp or vp:
             return x
-        return lax.psum(x, cfg.axis_name)
+        return comms.hist_allreduce(x, cfg.axis_name, qm)
+
+    def hist_psum_ef(x, ef):
+        """EF-threaded histogram reduction: the hot per-split child
+        reduction (and the root) consume + refill the error-feedback
+        residual carried in _CompactState.comm_ef so accumulated
+        quantization error telescopes instead of compounding
+        (comms.hist_allreduce docstring). ``ef`` passes through
+        untouched when the wire is exact f32 — and no reduction at all
+        happens under feature/voting parallelism (a local histogram is
+        already the one the search consumes)."""
+        if fp or vp:
+            return x, ef
+        return _psum_ef(x, ef)
 
     has_mono = monotone_constraints is not None
     # "advanced" (monotone precise mode) keeps intermediate's every-split
@@ -1217,7 +1309,15 @@ def _grow_compact_impl(cfg: GrowConfig,
             # psum the SMALL [k2, B, C] buffer, scatter back
             sel = jnp.sum(jnp.where(E[:, :, None, None], hist[None], 0),
                           axis=1)                         # [k2, B, C]
-            gsel = lax.psum(sel, ax)
+            # the elected-buffer exchange is the voting mode's one
+            # histogram reduction — quantize it under hist_comm too.
+            # Stateless + the vmap-safe shared-scale strategy: this
+            # site runs under jax.vmap (both children's searches fuse
+            # into one batched collective), where all_to_all has no
+            # batching story; int32 hists (quantized grads) fall back
+            # to the exact psum inside.
+            gsel = comms.hist_allreduce(sel, ax, cfg.hist_comm,
+                                        strategy="psum")
             ghist = jnp.sum(jnp.where(E[:, :, None, None], gsel[:, None],
                                       0), axis=0)         # [F, B, C]
             if bundled:
@@ -1654,7 +1754,7 @@ def _grow_compact_impl(cfg: GrowConfig,
                               cfg.hist_precision), valid
 
     def part_apply(bins2, pay2, ord2, lazy_used, src, start, cnt,
-                   f, t, dl, isc, cm, est_left_small):
+                   f, t, dl, isc, cm, est_left_small, comm_ef):
         """Stable two-way window compaction + child histogram in ONE
         streaming pass over the leaf's window.
 
@@ -1916,8 +2016,9 @@ def _grow_compact_impl(cfg: GrowConfig,
         # serial_tree_learner.cpp:789-791)
         nl_ex = psum(n_left_ib).astype(dtype)
         nr_ex = psum(n_ib - n_left_ib).astype(dtype)
+        est_hist, comm_ef = hist_psum_ef(est_hist, comm_ef)
         return (bins2, pay2, ord2, lazy_used, n_left, nl_ex, nr_ex,
-                hist_psum(est_hist), est_nu)
+                est_hist, est_nu, comm_ef)
 
     def window_hist(bins2, pay2, src, start, cnt):
         """Recompute one leaf's full histogram from its contiguous row
@@ -1962,6 +2063,7 @@ def _grow_compact_impl(cfg: GrowConfig,
     root_rows = _local_hist_rows(bins_pk, jnp.asarray(0, jnp.int32),
                                  n) if fp else bins_rm
     total_c = psum(jnp.sum(inbag.astype(dtype)))
+    comm_ef0 = jnp.zeros((FH, B, C), dtype) if use_ef else ()
     if quant:
         root_hist = hist_psum(hist_from_rows_int(root_rows, gw2_q, B,
                                                  hmethod))
@@ -1973,8 +2075,9 @@ def _grow_compact_impl(cfg: GrowConfig,
     else:
         total_g = psum(jnp.sum(gw2[:, 0]))
         total_h = psum(jnp.sum(gw2[:, 1]))
-        root_hist = hist_psum(hist_from_rows(root_rows, gw2, B, hmethod,
-                                             cfg.hist_precision))
+        root_hist, comm_ef0 = hist_psum_ef(
+            hist_from_rows(root_rows, gw2, B, hmethod,
+                           cfg.hist_precision), comm_ef0)
 
     tree = _init_tree(L, B, dtype)
     tree = tree._replace(
@@ -2066,7 +2169,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         branch=jnp.zeros((L, F_orig), jnp.bool_),
         num_splits=jnp.asarray(0, jnp.int32),
         cegb=cegb_state, mono=mono_state, node_masks=nmask_state,
-        pool=pool_state,
+        pool=pool_state, comm_ef=comm_ef0,
         # the first split's leaf is 0 (only the root has a stored
         # candidate), so the prefetched parent is the root histogram
         pcache=(jnp.zeros((1,), hists.dtype) if pooled else root_hist))
@@ -2209,7 +2312,7 @@ def _grow_compact_impl(cfg: GrowConfig,
                  leaf_override=None) -> _CompactState:
         (tree, best, hists, bins2, pay2, ord2, leaf_buf,
          lbegin, lcount, branch, ns, cegb_st, mono_st, nmask_st,
-         pool_st, pcache) = state
+         pool_st, comm_ef, pcache) = state
         leaf = jnp.argmax(best.gain).astype(jnp.int32) \
             if leaf_override is None else leaf_override
         R = ns + 1
@@ -2250,9 +2353,10 @@ def _grow_compact_impl(cfg: GrowConfig,
         # -- partition the leaf's range (DataPartition::Split analog) +
         # child histogram, fused into one streaming pass --
         (bins2, pay2, ord2, lazy_arr, n_left, nl_ex, nr_ex, est_hist,
-         est_nu) = part_apply(bins2, pay2, ord2, lazy_arr, src, start,
-                              cnt, f_split, t_bin, dl, isc, cm,
-                              est_left_small)
+         est_nu, comm_ef) = part_apply(bins2, pay2, ord2, lazy_arr,
+                                       src, start, cnt, f_split, t_bin,
+                                       dl, isc, cm, est_left_small,
+                                       comm_ef)
         # left child stays in the parent's half; right child was packed
         # into the opposite half
         leaf_buf = leaf_buf.at[R].set(1 - src)
@@ -2490,7 +2594,7 @@ def _grow_compact_impl(cfg: GrowConfig,
                              branch=branch, num_splits=ns + 1,
                              cegb=cegb_st, mono=mono_st,
                              node_masks=nmask_st, pool=pool_st,
-                             pcache=new_pcache)
+                             comm_ef=comm_ef, pcache=new_pcache)
 
     def forced_result(hist, tc, f, t, p_out, bnds) -> SplitResult:
         """Fixed (feature, bin) split record from a leaf's histogram

@@ -1,0 +1,27 @@
+# tpulint fixture: TPL010 negatives for the parallel/comms.py
+# wrappers — justified replicated-predicate sites and wrapper calls
+# outside conditionals report nothing.
+import jax.numpy as jnp
+from jax import lax
+
+from lightgbm_tpu.parallel import comms
+
+
+def justified_pool_miss(slot, hists, hist, axis, ef):
+    """The pooled compact grower's recompute-on-miss shape with the
+    replication invariant named on the pragma."""
+    # tpulint: replicated-cond slot is pool state derived only from the replicated tree/argmax sequence
+    return lax.cond(slot >= 0,
+                    lambda: hists[jnp.maximum(slot, 0)],
+                    lambda: comms.hist_allreduce(hist, axis, "int8"))
+
+
+def wrapper_outside_cond(pred, hist, axis):
+    """Every rank joins the quantized reduction; only local work
+    branches afterwards."""
+    g = comms.hist_allreduce(hist, axis, "int16")
+    return lax.cond(pred, lambda: g * 2.0, lambda: g)
+
+
+def f32_mode_is_still_a_collective_but_joined_by_all(hist, axis):
+    return comms.hist_allreduce(hist, axis, "f32")

@@ -1,0 +1,40 @@
+# tpulint fixture: TPL010 positives — the parallel/comms.py quantized
+# allreduce wrappers ARE device collectives: wrapping lax.psum in
+# comms.hist_allreduce must not blind the rule (ISSUE 9), including
+# when comms.py itself is outside the linted file set.
+import jax.numpy as jnp
+from jax import lax
+
+from lightgbm_tpu.parallel import comms
+
+
+def quantized_reduce_in_branch(pred, hist, axis):
+    """comms.hist_allreduce lexically inside a cond branch lambda."""
+    # EXPECT: TPL010
+    return lax.cond(pred,
+                    lambda: comms.hist_allreduce(hist, axis, "int8"),
+                    lambda: hist)
+
+
+def _pool_miss_recompute(hist, axis, ef):
+    """Local helper that transitively dispatches the quantized
+    allreduce — the ops/grow.py window_hist -> hist_psum_ef shape."""
+    return comms.hist_allreduce(hist, axis, "int16", ef)
+
+
+def branch_reaches_wrapper_through_helper(pred, hist, axis, ef):
+    """The hazard one call level down: the branch calls a local
+    function that reaches the comms wrapper through the call graph."""
+    # EXPECT: TPL010
+    return lax.cond(pred,
+                    lambda: _pool_miss_recompute(hist, axis, ef),
+                    lambda: (hist, ef))
+
+
+def bare_import_spelling(pred, hist, axis):
+    """`from ..parallel.comms import hist_allreduce` spelling."""
+    from lightgbm_tpu.parallel.comms import hist_allreduce
+    # EXPECT: TPL010
+    return lax.cond(pred,
+                    lambda: hist_allreduce(hist, axis, "int8"),
+                    lambda: hist)

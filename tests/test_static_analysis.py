@@ -178,6 +178,7 @@ _FIXTURES = [
     "serve/tpl008_pos.py", "serve/tpl008_neg.py",
     "tpl009_pos.py", "tpl009_neg.py",
     "tpl010_pos.py", "tpl010_neg.py",
+    "tpl010_comms_pos.py", "tpl010_comms_neg.py",
 ]
 
 # cross-module fixture: must be linted TOGETHER with the module whose
@@ -520,9 +521,78 @@ def test_stripping_the_pool_replicated_cond_pragma_fails(tmp_path):
         lambda src: src.replace(pragma, ""),
         ["TPL010"], tmp_path)
     fids = [f.fid for f in res.findings]
+    # since ISSUE 9 the pool-miss branch's reduction is the
+    # parallel/comms.py quantized-allreduce wrapper, and the rule
+    # names THAT collective (proof the wrapper recognizer, not the
+    # lax.psum closure, carries the detection in a single-file lint)
     assert ("TPL010:ops/grow.py:"
             "_grow_compact_impl._research_leafwise.body:"
-            "cond-collective:psum#1") in fids, fids
+            "cond-collective:hist_allreduce#1") in fids, fids
+
+
+def test_stripping_the_comms_recognizer_blinds_tpl010():
+    """The ISSUE 9 recognizer mutation: with the parallel/comms.py
+    wrapper entry stripped from TPL010, the quantized-allreduce
+    fixture's direct-call hazards go UNDETECTED — proving the
+    ``_COMMS_WRAPPERS`` entry (not an accident of the callgraph
+    closure) is what keeps wrapped collectives visible when comms.py
+    is outside the linted set."""
+    from lightgbm_tpu.analysis.rules_flow import CollectiveUnderTracedCond
+
+    res = run_lint(root=FIXTURES, package="tpulint_fixtures",
+                   files=["tpl010_comms_pos.py"], baseline_path="")
+    assert len(res.findings) == 3, [f.fid for f in res.findings]
+    saved = CollectiveUnderTracedCond._COMMS_WRAPPERS
+    try:
+        CollectiveUnderTracedCond._COMMS_WRAPPERS = frozenset()
+        mutated = run_lint(root=FIXTURES, package="tpulint_fixtures",
+                           files=["tpl010_comms_pos.py"],
+                           baseline_path="")
+    finally:
+        CollectiveUnderTracedCond._COMMS_WRAPPERS = saved
+    assert not mutated.findings, (
+        "a stripped recognizer must miss the wrapped collectives "
+        "(otherwise the entry is dead weight)",
+        [f.fid for f in mutated.findings])
+
+
+def test_stripping_the_comms_recognizer_blinds_tpl007():
+    """Same mutation for TPL007's host-order recognizer: a
+    comms.hist_allreduce dispatched from an `except` handler (an
+    untraced host path) must flag — and stop flagging when the
+    wrapper entry is removed from the collective set."""
+    from lightgbm_tpu.analysis.rules_flow import CollectiveOrder
+
+    src = (
+        "from lightgbm_tpu.parallel import comms\n\n\n"
+        "def retry_reduce(hist, axis):\n"
+        "    try:\n"
+        "        return comms.hist_allreduce(hist, axis, 'int8')\n"
+        "    except RuntimeError:\n"
+        "        return comms.hist_allreduce(hist, axis, 'f32')\n")
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "comms_host.py")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(src)
+        res = run_lint(root=td, package="tpulint_fixtures",
+                       files=["comms_host.py"], baseline_path="",
+                       rules=["TPL007"])
+        assert any(f.rule == "TPL007"
+                   and f.symbol == "collective:hist_allreduce"
+                   for f in res.findings), [f.fid for f in res.findings]
+        saved = CollectiveOrder._COLLECTIVES
+        try:
+            CollectiveOrder._COLLECTIVES = \
+                saved - CollectiveOrder._COMMS_WRAPPERS
+            mutated = run_lint(root=td, package="tpulint_fixtures",
+                               files=["comms_host.py"],
+                               baseline_path="", rules=["TPL007"])
+        finally:
+            CollectiveOrder._COLLECTIVES = saved
+        assert not any(f.symbol == "collective:hist_allreduce"
+                       for f in mutated.findings), (
+            [f.fid for f in mutated.findings])
 
 
 def test_threadsafe_pragma_requires_a_reason():
