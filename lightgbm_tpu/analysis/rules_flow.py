@@ -97,6 +97,16 @@ class CollectiveOrder(Rule):
     #: is load-bearing.
     _COMMS_WRAPPERS = frozenset({"hist_allreduce"})
 
+    #: host-sync wrappers from parallel/placement.py (docs/SHARDING.md):
+    #: the per-rank upload barrier and the sharded-checkpoint gather
+    #: are world-joining host collectives one level up — rank-guarding
+    #: a call site skips a world join exactly like skipping the
+    #: underlying allgather (``fetch_addressable`` is deliberately NOT
+    #: here: it never joins a collective by construction). Kept as its
+    #: own set so the placement mutation test can prove the entries
+    #: are load-bearing.
+    _PLACEMENT_WRAPPERS = frozenset({"upload_barrier", "fetch_global"})
+
     #: direct host-collective entry points (basenames — matches both
     #: resolved package functions and unresolved externals, so fixtures
     #: and the real tree hit the same detector)
@@ -105,7 +115,8 @@ class CollectiveOrder(Rule):
                     "aggregate_phase_snapshot", "process_allgather",
                     "broadcast_one_to_all", "sync_global_devices",
                     "wait_at_barrier",
-                    "assert_equal_per_process"} | _COMMS_WRAPPERS
+                    "assert_equal_per_process"} \
+        | _COMMS_WRAPPERS | _PLACEMENT_WRAPPERS
 
     def run(self, ctx: LintContext) -> Iterator[Finding]:
         reaches = self._reaches_collective(ctx.graph)

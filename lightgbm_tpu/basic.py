@@ -381,6 +381,8 @@ class Dataset:
         self._bins: Optional[np.ndarray] = None       # [n, F_used]
         self._used_features: Optional[np.ndarray] = None
         self._device_bins = None
+        self._data_digest: Optional[str] = None
+        self._host_bins_freed = False
         self._feature_names: List[str] = []
         self._pandas_categorical = None
         self._n: int = 0
@@ -1126,12 +1128,48 @@ class Dataset:
         import jax.numpy as jnp
         self.construct()
         if self._device_bins is None:
+            if self._bins is None and getattr(self, "_host_bins_freed",
+                                              False):
+                raise LightGBMError(
+                    "the host binned matrix was freed after device "
+                    "placement and no device view was registered "
+                    "(shard_residency=device; docs/SHARDING.md)")
             self._device_bins = jnp.asarray(self._bins.T)
         return self._device_bins
 
     def host_bins(self) -> np.ndarray:
         self.construct()
+        if self._bins is None and getattr(self, "_host_bins_freed",
+                                          False):
+            raise LightGBMError(
+                "the host binned matrix was freed after device "
+                "placement (shard_residency=device; docs/SHARDING.md) "
+                "— construct the Dataset with shard_residency=host if "
+                "a host copy is required")
         return self._bins
+
+    def free_host_bins(self) -> None:
+        """Release the host binned matrix after device placement
+        (shard_residency=device, parallel/placement.py). The checkpoint
+        data fingerprint is computed FIRST and cached on the Dataset
+        (``_data_digest``) so resume validation keeps working without
+        the bins; subsequent ``host_bins()`` calls raise a clear error
+        instead of returning None."""
+        if self._bins is None:
+            return
+        if self._data_digest is None and self.label is not None:
+            from .data.ingest import dataset_digest
+            self._data_digest = dataset_digest(
+                np.asarray(self.label, np.float64), self._bins)
+        try:
+            from .obs.registry import registry
+            registry.gauge("host_binned_bytes").set(0.0)
+        except Exception:
+            pass
+        self._bins = None
+        self._device_bins = None
+        self._bundle_info = None
+        self._host_bins_freed = True
 
     def bundles(self, cfg):
         """Exclusive-feature-bundling info (ops/bundling.py), or None
@@ -1149,6 +1187,13 @@ class Dataset:
                 cached.bins_bundled.shape[0] == self._n \
                 and getattr(self, "_bundle_cat_cap", None) == cap:
             return cached
+        if self._bins is None and getattr(self, "_host_bins_freed",
+                                          False):
+            raise LightGBMError(
+                "the host binned matrix was freed after device "
+                "placement (shard_residency=device; docs/SHARDING.md) "
+                "— bundles cannot be rebuilt; reconstruct the Dataset "
+                "to retrain with EFB")
         from .ops.bundling import build_bundles
         self._bundle_info = build_bundles(
             self._bins, self.mappers, max_cat_onehot=cap)

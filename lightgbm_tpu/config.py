@@ -545,6 +545,30 @@ class Config:
     # feature-parallel (no histogram reduction) and quantized-gradient
     # histograms (already exact int32).
     hist_comm: str = "f32"
+    # where the binned training matrix lives (parallel/placement.py;
+    # docs/SHARDING.md): "host" keeps the classic host numpy copy and
+    # uploads a device copy; "device" lays each rank's binned shard
+    # DIRECTLY into its NamedSharding mesh slice
+    # (jax.make_array_from_single_device_arrays) and frees the host
+    # copy after the upload — no host ever holds the global binned
+    # matrix (the gate on datasets whose binned form exceeds one
+    # host). "auto" = device when a multi-device mesh is active on an
+    # accelerator backend, host otherwise (CPU virtual-device worlds
+    # keep host so eager consumers stay cheap; tests opt in
+    # explicitly).
+    shard_residency: str = "auto"
+    # data-parallel split search (ops/grow.py GrowConfig.split_search;
+    # docs/SHARDING.md): "gathered" allreduces the full [F, B, 2]
+    # histogram and every device searches all features; "sharded"
+    # reduce-scatters it so each device searches only its owned F/D
+    # feature chunk and the per-device best SplitInfo records are
+    # allreduced (the reference DataParallelTreeLearner's
+    # ReduceScatter + SyncUpGlobalBestSplit) — post-reduction traffic
+    # drops to a 1/D chunk + O(D) split records while split decisions
+    # stay byte-identical. Applies to tree_learner=data meshes;
+    # feature/voting already shard their searches. EFB-bundled runs
+    # fall back to gathered (not covered yet).
+    split_search: str = "gathered"
     sharding_axis: str = "data"  # mesh axis name for row sharding
     # histogram build strategy: auto|scatter|mxu|pallas. auto: nibble
     # matmul (MXU) on TPU and scatter-add on CPU; pallas: hand-tiled
@@ -665,6 +689,14 @@ class Config:
         if self.hist_comm not in ("f32", "int16", "int8", "auto"):
             raise ValueError(f"Unknown hist_comm: {self.hist_comm} "
                              "(expected f32, int16, int8 or auto)")
+        if self.shard_residency not in ("auto", "host", "device"):
+            raise ValueError(
+                f"Unknown shard_residency: {self.shard_residency} "
+                "(expected auto, host or device)")
+        if self.split_search not in ("gathered", "sharded"):
+            raise ValueError(
+                f"Unknown split_search: {self.split_search} "
+                "(expected gathered or sharded)")
         if self.monotone_constraints_method not in (
                 "basic", "intermediate", "advanced"):
             raise ValueError(

@@ -345,7 +345,11 @@ class GBDTBooster:
         # columns by rows and psums their histograms; feature-parallel
         # windows/owns bundle columns like plain columns; voting runs
         # its ballot/election/exchange in bundle-column space.
-        plain = (not cfg.linear_tree and grower == "compact")
+        plain = (not cfg.linear_tree and grower == "compact"
+                 # a locally-sharded dataset (distributed_dataset
+                 # device residency on a pod) holds only this rank's
+                 # rows — per-rank bundle decisions would diverge
+                 and getattr(ds, "_local_row_offset", None) is None)
         if cfg.enable_bundle and plain:
             binfo = ds.bundles(cfg)
             if binfo is not None:
@@ -383,9 +387,13 @@ class GBDTBooster:
         self._fmask_cached = None
 
         # only ONE training matrix ever reaches HBM: bundled when EFB
-        # engaged, the plain [F, n] matrix otherwise
-        self.bins_T = jnp.asarray(self.bundle.bins_bundled.T) \
-            if self.bundle is not None else ds.device_bins()
+        # engaged, the plain [F, n] matrix otherwise. Materialization
+        # is DEFERRED below the mesh decision so shard_residency=device
+        # can lay each row shard directly into its NamedSharding mesh
+        # slice (parallel/placement.py) without first pinning an
+        # unsharded device copy — and free the host copy after upload.
+        ncols = int(self.bundle.bins_bundled.shape[1]) \
+            if self.bundle is not None else self.F
 
         # -- histogram cache budget (HistogramPool analog;
         # histogram_pool_size in MB, -1 = unlimited like the reference,
@@ -394,7 +402,6 @@ class GBDTBooster:
         # pooled re-search (recompute-on-miss), like the reference pool
         # serves all consumers. --
         if cfg.histogram_pool_size > 0 and grower == "compact":
-            ncols = int(self.bins_T.shape[0])
             per_leaf = ncols * self.grow_cfg.num_bins * 2 * 4
             slots = int(cfg.histogram_pool_size * 2 ** 20 // per_leaf)
             slots = max(2, slots)
@@ -418,7 +425,6 @@ class GBDTBooster:
             self.mesh = make_mesh(cfg.num_devices)
             D = int(self.mesh.devices.size)
             mode = dp_mode
-            ncols = int(self.bins_T.shape[0])
             if cfg.tree_learner == "auto":
                 # payload-adaptive choice (ROADMAP item 2): re-derived
                 # per tree from (F, B, rows, world, wire dtype) — all
@@ -477,14 +483,104 @@ class GBDTBooster:
                     "monotone_constraints_method=basic")
                 self.grow_cfg = self.grow_cfg._replace(
                     monotone_method="basic")
+            # reduce-scatter sharded split search (docs/SHARDING.md):
+            # data-parallel meshes only — feature/voting already shard
+            # their searches; EFB-bundled matrices keep the gathered
+            # search (grow_tree_impl would raise)
+            ss = cfg.split_search
+            if ss == "sharded" and (mode != "data"
+                                    or self.bundle is not None):
+                if self.bundle is not None and mode == "data":
+                    from ..utils.log import log_warning
+                    log_warning(
+                        "split_search=sharded does not cover EFB-"
+                        "bundled matrices yet; using the gathered "
+                        "search")
+                ss = "gathered"
             self.grow_cfg = self.grow_cfg._replace(
-                parallel_mode=mode, voting_top_k=cfg.top_k)
+                parallel_mode=mode, voting_top_k=cfg.top_k,
+                split_search=ss)
             # feature-parallel replicates rows; no shard padding needed
             self._pad = 0 if mode == "feature" else pad_rows(self.n, D)
+            self._grow_fn = self._build_grow_fn()
+
+        # -- training-matrix materialization + shard residency ---------
+        # (parallel/placement.py, docs/SHARDING.md): "device" lays each
+        # mesh slice's rows directly into its device and FREES the host
+        # binned matrix afterwards — no host holds the global matrix;
+        # "host" keeps the classic host copy + device upload. auto =
+        # device only on accelerator meshes (CPU virtual-device worlds
+        # keep host so eager consumers stay cheap).
+        residency = cfg.shard_residency
+        if residency == "auto":
+            residency = ("device" if self.mesh is not None
+                         and jax.default_backend() != "cpu" else "host")
+        local_off = getattr(ds, "_local_row_offset", None)
+        if local_off is not None:
+            # distributed_dataset kept only this rank's binned shard —
+            # the dataset is device-destined by construction
+            residency = "device"
+            if self.mesh is not None \
+                    and self.grow_cfg.parallel_mode == "feature":
+                from ..basic import LightGBMError
+                raise LightGBMError(
+                    "feature-parallel growth replicates the full row "
+                    "set on every device, but this rank holds only its "
+                    "binned shard (shard_residency=device kept the "
+                    "allgather from running) — use tree_learner=data "
+                    "or shard_residency=host for feature-parallel")
+        if residency == "device" and self.mesh is not None \
+                and self.grow_cfg.parallel_mode == "feature":
+            # feature-parallel replicates rows on every device — there
+            # is no mesh slice to own; keep the host path
+            residency = "host"
+        self._residency = residency
+        host_mat = (self.bundle.bins_bundled if self.bundle is not None
+                    else ds.host_bins())             # [n, C] row-major
+        from ..parallel import placement
+        if residency == "device":
+            if self.mesh is not None:
+                # per-device slices cut straight from the host rows —
+                # the unsharded [C, n] device copy never exists
+                if local_off is None:
+                    self.bins_T = placement.place_rows(
+                        self.mesh, host_mat.T, row_axis=1,
+                        pad=self._pad)
+                else:
+                    plan = placement.ShardPlan(self.mesh,
+                                               self.n + self._pad)
+                    self.bins_T = plan.place(host_mat.T, row_axis=1,
+                                             local_offset=int(local_off),
+                                             exclusive_rows=True)
+                placement.upload_barrier()
+            else:
+                self.bins_T = jnp.asarray(host_mat.T)
+            ds.free_host_bins()
+            if self.bundle is None:
+                if not self._pad:
+                    # the placed matrix doubles as the dataset's device
+                    # view, so binned-traversal consumers (init_model
+                    # preload, OOM score rebuild) keep working without
+                    # a host copy; with row padding the shapes differ
+                    # and those rare paths raise free_host_bins' clear
+                    # error instead of silently mixing padded rows in
+                    ds._device_bins = self.bins_T
+            else:
+                # EFB keeps its (post-bundle) host matrix for now —
+                # the Dataset-level [n, F] copy (the larger one) is
+                # freed above; docs/SHARDING.md records the gap
+                placement.host_bytes_gauge(host_mat.nbytes)
+        else:
+            self.bins_T = jnp.asarray(host_mat.T) \
+                if self.bundle is not None else ds.device_bins()
             if self._pad:
                 self.bins_T = jnp.pad(self.bins_T,
                                       ((0, 0), (0, self._pad)))
-            self._grow_fn = self._build_grow_fn()
+            placement.host_bytes_gauge(host_mat.nbytes)
+
+        # score matrix follows the residency (sharded checkpoint
+        # save/restore goes through placement.fetch_global)
+        self.score = self._place_score(self.score)
 
         seed = cfg.seed if cfg.seed is not None else 0
         self._base_key = jax.random.PRNGKey(seed)
@@ -690,7 +786,8 @@ class GBDTBooster:
         # accumulation (bit-exact resume vs an uninterrupted run is
         # forfeited past this point, which an OOM'd run already is).
         if getattr(self.score, "is_deleted", lambda: False)():
-            self.score = self._score_dataset_binned(self.train_set)
+            self.score = self._place_score(
+                self._score_dataset_binned(self.train_set))
             detail += "; score buffer was donated to the failed " \
                       "dispatch — rebuilt from trees"
         self._record_fault("oom", self.iter_, action, detail)
@@ -777,11 +874,20 @@ class GBDTBooster:
             n_reductions = self.K * levels * self.cfg.num_leaves
         else:
             n_reductions = int(leaves)
+        world = int(self.mesh.devices.size)
+        # the comm model's reduce-scatter arm: what each device
+        # RECEIVES after the reduce phase (full broadcast when
+        # gathered, 1/D chunk + O(D) SplitInfo records when sharded)
+        post = comms.post_reduction_bytes(
+            g.parallel_mode, ncols, g.num_bins, world, g.split_search,
+            g.hist_comm, g.voting_top_k)
         return {
             "payload_bytes": int(per_reduction) * n_reductions,
+            "post_reduction_bytes": int(post) * n_reductions,
             "hist_comm": g.hist_comm,
             "parallel_mode": g.parallel_mode,
-            "world": int(self.mesh.devices.size),
+            "split_search": g.split_search,
+            "world": world,
         }
 
     def preload_models(self, trees: List[Tree],
@@ -801,10 +907,26 @@ class GBDTBooster:
         self._tree_weights = [1.0] * len(self.models)
         self.iter_ = len(self.models) // self.K
         if score is not None:
-            self.score = jnp.asarray(
+            self.score = self._place_score(
                 np.asarray(score, np.float32).reshape(self.K, self.n))
         else:
-            self.score = self._score_dataset_binned(self.train_set)
+            self.score = self._place_score(
+                self._score_dataset_binned(self.train_set))
+
+    def _place_score(self, score):
+        """Install a [K, n] raw-score matrix per the shard residency:
+        column-sharded over the mesh's data axis under device
+        residency (a single-controller mesh — every eager consumer
+        stays valid; the checkpoint layer saves/restores it through
+        placement.fetch_global with per-shard fingerprints), a plain
+        device array otherwise."""
+        if getattr(self, "_residency", "host") != "device" \
+                or self.mesh is None:
+            return jnp.asarray(score)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(
+            jnp.asarray(score),
+            NamedSharding(self.mesh, P(None, self.mesh.axis_names[0])))
 
     # ------------------------------------------------------------------
     def add_valid(self, dataset, name: str) -> None:

@@ -376,6 +376,7 @@ def summarize_events(path: str) -> dict:
     serve: Optional[Dict[str, object]] = None
     serve_events = 0
     comm_bytes = 0
+    comm_post_bytes = 0
     comm_last: Optional[Dict[str, object]] = None
 
     def _parse(line: str, is_last: bool) -> Optional[dict]:
@@ -443,12 +444,17 @@ def summarize_events(path: str) -> dict:
         if ev.get("comm"):
             comm_last = ev["comm"]
             comm_bytes += int(ev["comm"].get("payload_bytes", 0))
+            comm_post_bytes += int(ev["comm"].get(
+                "post_reduction_bytes",
+                ev["comm"].get("payload_bytes", 0)))
     return {"iterations": iters, "wall_time": wall, "phases": phases,
             "recompiles": recompiles, "peak_hbm_bytes": peak_hbm,
             "total_leaves": leaves, "total_split_gain": gain,
             "last_eval": last_eval, "faults": faults, "ingest": ingest,
             "serve": serve, "serve_events": serve_events,
-            "comm_bytes": comm_bytes, "comm": comm_last}
+            "comm_bytes": comm_bytes,
+            "comm_post_reduction_bytes": comm_post_bytes,
+            "comm": comm_last}
 
 
 def render_stats_table(summary: dict) -> str:
@@ -485,11 +491,14 @@ def render_stats_table(summary: dict) -> str:
     comm = summary.get("comm")
     if comm:
         cb = summary.get("comm_bytes", 0)
+        pb = summary.get("comm_post_reduction_bytes", cb)
         lines.append(
             f"comm payload         : {cb / 2**20:.1f} MiB modeled "
             f"({comm.get('parallel_mode', '?')}-parallel, "
-            f"hist_comm {comm.get('hist_comm', '?')}, world "
-            f"{comm.get('world', '?')})")
+            f"hist_comm {comm.get('hist_comm', '?')}, "
+            f"{comm.get('split_search', 'gathered')} search, world "
+            f"{comm.get('world', '?')}; post-reduction "
+            f"{pb / 2**20:.1f} MiB)")
     lines.append(f"leaves grown         : {summary['total_leaves']}")
     lines.append(f"split gain sum       : {summary['total_split_gain']:g}")
     faults = summary.get("faults") or {}

@@ -53,6 +53,34 @@ def _axis_size(name) -> int:
 
 NEG_INF = -jnp.inf
 
+
+def _combine_split_infos(r: SplitResult, axis_name) -> SplitResult:
+    """SyncUpGlobalBestSplit (parallel_tree_learner.h:209-232):
+    allreduce the max-gain SplitInfo across devices searching disjoint
+    feature subsets; ties resolve to the lower feature id (SplitInfo
+    total order, split_info.hpp). Shared by the feature-parallel mode
+    and the sharded data-parallel split search — with disjoint
+    ownership exactly one device wins, so the psum-broadcast of each
+    field is the winner's exact bit pattern."""
+    gmax = lax.pmax(r.gain, axis_name)
+    at_max = r.gain >= gmax
+    packed = jnp.where(at_max, r.feature, jnp.int32(2 ** 30))
+    fwin = lax.pmin(packed, axis_name)
+    win = at_max & (r.feature == fwin)
+    cnt = lax.psum(win.astype(jnp.float32), axis_name)
+
+    def bc(x):
+        xf = x.astype(jnp.float32)
+        mean = lax.psum(jnp.where(win, xf, 0.0), axis_name) / cnt
+        if x.dtype == jnp.bool_:
+            return mean > 0.5
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            return jnp.round(mean).astype(x.dtype)
+        return mean.astype(x.dtype)
+
+    return SplitResult(*(bc(field) for field in r))
+
+
 class GrowConfig(NamedTuple):
     """Static (trace-time) growth configuration.
 
@@ -164,6 +192,27 @@ class GrowConfig(NamedTuple):
     # training (cfg.quantized: exact int32 histograms) and the
     # feature-parallel mode (no histogram reduction) ignore it.
     hist_comm: str = "f32"
+    # data-parallel split search (parallel/comms.py, docs/SHARDING.md):
+    # "gathered" — the reduced [F, B, 2] histogram is allreduced and
+    #              every device searches all features (the legacy psum
+    #              path; XLA's ring allreduce broadcasts the full
+    #              payload back to every device);
+    # "sharded"  — the reference DataParallelTreeLearner's
+    #              ReduceScatter + per-worker feature-subset search
+    #              (data_parallel_tree_learner.cpp:223-300): histograms
+    #              are reduce-scattered so each device owns and
+    #              searches only its ceil(F/D) feature chunk, then the
+    #              per-device best SplitInfo records are allreduced
+    #              (SyncUpGlobalBestSplit). Post-reduction traffic
+    #              drops from the full histogram broadcast to a 1/D
+    #              chunk + O(D) split records; split decisions are
+    #              byte-identical to the gathered path (psum_scatter
+    #              chunks are bit-identical to psum slices; the
+    #              SplitInfo combine broadcasts the single winner's
+    #              exact field bits).
+    # Only meaningful under axis_name + parallel_mode="data"; feature/
+    # voting parallelism have their own search sharding already.
+    split_search: str = "gathered"
 
 
 class TreeArrays(NamedTuple):
@@ -363,6 +412,11 @@ def grow_tree_impl(cfg: GrowConfig,
       node_key: PRNG key for per-node column sampling
         (feature_fraction_bynode; cfg.bynode < 1).
     """
+    if cfg.split_search == "sharded" and cfg.bundled:
+        raise NotImplementedError(
+            "split_search='sharded' does not cover EFB bundling yet — "
+            "the engine keeps bundled runs on the gathered search "
+            "(models/gbdt.py)")
     if cfg.grower == "compact":
         return _grow_compact_impl(cfg, bins_T, grad, hess, row_weight,
                                   feature_mask, feat_num_bins, feat_nan_bin,
@@ -407,6 +461,54 @@ def grow_tree_impl(cfg: GrowConfig,
                              monotone_constraints, feat_is_cat)
 
 
+def _make_sharded_search(cfg: GrowConfig, F: int, qm: str,
+                         use_ef: bool):
+    """Reduce-scatter sharded-search context shared by every grower
+    (docs/SHARDING.md): each device owns ``Fl = ceil(F/D)`` features
+    of the reduced histogram (feature axis padded to ``Fsp = D * Fl``
+    so psum_scatter chunks align), searches only its chunk, and the
+    winning SplitInfo is allreduced — the reference
+    DataParallelTreeLearner shape. Returns ``(Fl, Fsp, f_start,
+    dev_idx, rs_pad, hist_psum_ef, owned_slice)``; the feature axis is
+    third-from-last in every histogram shape the growers reduce
+    ([F, B, 2] root / [L, F, B, 2] level batch), so the scatter axis
+    is positional. Must be called inside the traced program (it takes
+    ``lax.axis_index``)."""
+    D_sh = _axis_size(cfg.axis_name)
+    dev_idx = lax.axis_index(cfg.axis_name)
+    Fl = -(-F // D_sh)
+    Fsp = Fl * D_sh
+    f_start = dev_idx * Fl
+
+    def rs_pad(x):
+        """Pad the feature axis (third-from-last) to Fsp."""
+        if Fsp == F:
+            return x
+        pw = [(0, 0)] * x.ndim
+        pw[x.ndim - 3] = (0, Fsp - F)
+        return jnp.pad(x, pw)
+
+    def hist_psum_ef(x, ef):
+        x = rs_pad(x)
+        ax = x.ndim - 3
+        if not use_ef:
+            return lax.psum_scatter(
+                x, cfg.axis_name, scatter_dimension=ax,
+                tiled=True), ef
+        return comms.hist_reduce_scatter(x, cfg.axis_name, qm, ef, ax)
+
+    def owned_slice(v, fill):
+        """This device's Fl-slice of a per-feature vector."""
+        if v is None:
+            return None
+        if Fsp > F:
+            padv = jnp.full((Fsp - F,), fill, v.dtype)
+            v = jnp.concatenate([v, padv])
+        return lax.dynamic_slice(v, (f_start,), (Fl,))
+
+    return Fl, Fsp, f_start, dev_idx, rs_pad, hist_psum_ef, owned_slice
+
+
 def _grow_masked_impl(cfg: GrowConfig,
                       bins_T: jnp.ndarray,
                       grad: jnp.ndarray,
@@ -424,17 +526,38 @@ def _grow_masked_impl(cfg: GrowConfig,
     n = bins_T.shape[1]
     dtype = grad.dtype
     p = cfg.split
+    sharded = (cfg.axis_name is not None and cfg.parallel_mode == "data"
+               and cfg.split_search == "sharded")
 
     def psum(x):
         return lax.psum(x, cfg.axis_name) if cfg.axis_name else x
 
-    _, use_ef, hist_psum_ef = comms.make_hist_psum_ef(
+    qm, use_ef, _gath_ef = comms.make_hist_psum_ef(
         cfg.axis_name, cfg.hist_comm)
 
-    def best_for(hist, sg, sh, sc):
-        return find_best_split(hist, sg, sh, sc, feat_num_bins, feat_nan_bin,
-                               feature_mask, p, monotone_constraints,
-                               feat_is_cat)
+    if sharded:
+        Fl, Fsp, f_start, dev_idx, _rs_pad, hist_psum_ef, _ssl = \
+            _make_sharded_search(cfg, F, qm, use_ef)
+        FH = Fl
+
+        def best_for(hist, sg, sh, sc):
+            owned = (f_start + jnp.arange(Fl)) < F
+            r = find_best_split(hist, sg, sh, sc,
+                                _ssl(feat_num_bins, 1),
+                                _ssl(feat_nan_bin, -1),
+                                _ssl(feature_mask, False) & owned, p,
+                                _ssl(monotone_constraints, 0),
+                                _ssl(feat_is_cat, False))
+            r = r._replace(feature=r.feature + f_start)
+            return _combine_split_infos(r, cfg.axis_name)
+    else:
+        FH = F
+        hist_psum_ef = _gath_ef
+
+        def best_for(hist, sg, sh, sc):
+            return find_best_split(hist, sg, sh, sc, feat_num_bins,
+                                   feat_nan_bin, feature_mask, p,
+                                   monotone_constraints, feat_is_cat)
 
     # ---- root (GlobalSyncUpBySum analog for the root tuple) ----
     w = row_weight.astype(dtype)
@@ -443,7 +566,8 @@ def _grow_masked_impl(cfg: GrowConfig,
     total_h = psum(jnp.sum(hess * w))
     total_c = psum(jnp.sum(inbag.astype(dtype)))
     all_rows = jnp.ones((n,), jnp.bool_)
-    comm_ef0 = jnp.zeros((F, B, 2), dtype) if use_ef else ()
+    comm_ef0 = jnp.zeros((Fsp if sharded else F, B, 2), dtype) \
+        if use_ef else ()
     root_hist, comm_ef0 = hist_psum_ef(
         build_histogram(bins_T, grad, hess, row_weight, all_rows, B,
                         cfg.hist_method, cfg.hist_precision), comm_ef0)
@@ -457,7 +581,7 @@ def _grow_masked_impl(cfg: GrowConfig,
     best = _BestSplits.init(L, B, dtype)
     best = best.store(0, best_for(root_hist, total_g, total_h, total_c),
                       jnp.asarray(True))
-    hists = jnp.zeros((L, F, B, 2), dtype).at[0].set(root_hist)
+    hists = jnp.zeros((L, FH, B, 2), dtype).at[0].set(root_hist)
     state = _GrowState(tree=tree, best=best, hists=hists,
                        row_leaf=jnp.zeros((n,), jnp.int32),
                        num_splits=jnp.asarray(0, jnp.int32),
@@ -617,17 +741,38 @@ def _grow_level_impl(cfg: GrowConfig,
     has_cat = feat_is_cat is not None
     hmethod = cfg.hist_method \
         if cfg.hist_method in ("scatter", "pallas") else "mxu"
+    sharded = (cfg.axis_name is not None and cfg.parallel_mode == "data"
+               and cfg.split_search == "sharded")
 
     def psum(x):
         return lax.psum(x, cfg.axis_name) if cfg.axis_name else x
 
-    _, use_ef, hist_psum_ef = comms.make_hist_psum_ef(
+    qm, use_ef, _gath_ef = comms.make_hist_psum_ef(
         cfg.axis_name, cfg.hist_comm)
 
-    def best_for(hist, sg, sh, sc):
-        return find_best_split(hist, sg, sh, sc, feat_num_bins,
-                               feat_nan_bin, feature_mask, p,
-                               monotone_constraints, feat_is_cat)
+    if sharded:
+        Fl, Fsp, f_start, dev_idx, _rs_pad, hist_psum_ef, _ssl = \
+            _make_sharded_search(cfg, F, qm, use_ef)
+        FH = Fl
+
+        def best_for(hist, sg, sh, sc):
+            owned = (f_start + jnp.arange(Fl)) < F
+            r = find_best_split(hist, sg, sh, sc,
+                                _ssl(feat_num_bins, 1),
+                                _ssl(feat_nan_bin, -1),
+                                _ssl(feature_mask, False) & owned, p,
+                                _ssl(monotone_constraints, 0),
+                                _ssl(feat_is_cat, False))
+            r = r._replace(feature=r.feature + f_start)
+            return _combine_split_infos(r, cfg.axis_name)
+    else:
+        FH = F
+        hist_psum_ef = _gath_ef
+
+        def best_for(hist, sg, sh, sc):
+            return find_best_split(hist, sg, sh, sc, feat_num_bins,
+                                   feat_nan_bin, feature_mask, p,
+                                   monotone_constraints, feat_is_cat)
 
     def depth_ok(d):
         if cfg.max_depth <= 0:
@@ -643,10 +788,11 @@ def _grow_level_impl(cfg: GrowConfig,
     total_c = psum(jnp.sum(inbag.astype(dtype)))
     all_rows = jnp.ones((n,), jnp.bool_)
     comm_ef0 = ()
+    FE = Fsp if sharded else F        # EF feature width (scatter-padded)
     if use_ef:
         # EF shape follows the reduction the path issues (_LevelState)
         if hmethod == "scatter":
-            comm_ef0 = jnp.zeros((L, F, B, 2), dtype)
+            comm_ef0 = jnp.zeros((L, FE, B, 2), dtype)
             root_hist, ef_slot0 = hist_psum_ef(
                 build_histogram(bins_T, grad, hess, row_weight,
                                 all_rows, B, hmethod,
@@ -658,11 +804,11 @@ def _grow_level_impl(cfg: GrowConfig,
                 build_histogram(bins_T, grad, hess, row_weight,
                                 all_rows, B, hmethod,
                                 cfg.hist_precision),
-                jnp.zeros((F, B, 2), dtype))
+                jnp.zeros((FE, B, 2), dtype))
     else:
-        root_hist = psum(build_histogram(bins_T, grad, hess, row_weight,
-                                         all_rows, B, hmethod,
-                                         cfg.hist_precision))
+        root_hist, _ = hist_psum_ef(
+            build_histogram(bins_T, grad, hess, row_weight, all_rows,
+                            B, hmethod, cfg.hist_precision), ())
     tree = _init_tree(L, B, dtype)
     tree = tree._replace(
         leaf_value=tree.leaf_value.at[0].set(
@@ -673,7 +819,7 @@ def _grow_level_impl(cfg: GrowConfig,
     best = _BestSplits.init(L, B, dtype)
     best = best.store(0, best_for(root_hist, total_g, total_h, total_c),
                       jnp.asarray(True))
-    hists = jnp.zeros((L, F, B, 2), dtype).at[0].set(root_hist)
+    hists = jnp.zeros((L, FH, B, 2), dtype).at[0].set(root_hist)
     state = _LevelState(tree=tree, best=best, hists=hists,
                         row_leaf=jnp.zeros((n,), jnp.int32),
                         num_splits=jnp.asarray(0, jnp.int32),
@@ -781,12 +927,10 @@ def _grow_level_impl(cfg: GrowConfig,
                     h = build_histogram(bins_T, grad, hess, row_weight,
                                         mask, B, hmethod,
                                         cfg.hist_precision)
-                    if use_ef:
-                        # rolling EF: each child reduction consumes +
-                        # refills the one [F, B, 2] buffer in sequence
-                        h, ef = hist_psum_ef(h, ef)
-                    else:
-                        h = psum(h)
+                    # rolling EF: each child reduction consumes +
+                    # refills the one [F, B, 2] buffer in sequence
+                    # (ef passes through untouched at exact f32 wire)
+                    h, ef = hist_psum_ef(h, ef)
                     acc = lax.dynamic_update_index_in_dim(
                         acc, h, small_slot[l], axis=0)
                     return acc, ef
@@ -796,7 +940,7 @@ def _grow_level_impl(cfg: GrowConfig,
 
             small_hists, comm_ef = lax.fori_loop(
                 0, L, hist_one,
-                (jnp.zeros((L, F, B, 2), dtype), comm_ef))
+                (jnp.zeros((L, FH, B, 2), dtype), comm_ef))
 
         def sib_one(l, hists):
             def do(hists):
@@ -816,7 +960,19 @@ def _grow_level_impl(cfg: GrowConfig,
         # -- 4. score the whole new frontier in one vmapped batch;
         # every other slot (including just-retired frontier leaves that
         # didn't make the election) drops to -inf and never splits --
-        sums = hists[:, 0].sum(axis=1)                   # [L, 2]
+        if sharded:
+            # leaf (g, h) totals from the GLOBAL feature-0 histogram
+            # row — owned by device 0 (f_start == 0), broadcast with
+            # one tiny [L, B, 2] psum so every device sums the exact
+            # bin sequence the gathered path sums (hists[:, 0] on a
+            # chunk is a different feature per device: same total,
+            # different addition order, hence different last-ulp bits)
+            row0 = lax.psum(
+                jnp.where(dev_idx == 0, hists[:, 0],
+                          jnp.zeros_like(hists[:, 0])), cfg.axis_name)
+            sums = row0.sum(axis=1)                      # [L, 2]
+        else:
+            sums = hists[:, 0].sum(axis=1)               # [L, 2]
         r = jax.vmap(best_for)(hists, sums[:, 0], sums[:, 1],
                                tree.leaf_count)
         is_child = (slots < tree.num_leaves) \
@@ -1068,6 +1224,15 @@ def _grow_compact_impl(cfg: GrowConfig,
 
     fp = cfg.axis_name is not None and cfg.parallel_mode == "feature"
     vp = cfg.axis_name is not None and cfg.parallel_mode == "voting"
+    # reduce-scatter sharded split search (docs/SHARDING.md): data-
+    # parallel rows + feature-parallel search. Histograms built over
+    # local rows are reduce-scattered so each device owns (and
+    # searches) only its ceil(F/D) feature chunk of the globally
+    # reduced histogram; the winning SplitInfo records are allreduced
+    # (_fp_combine) — the reference DataParallelTreeLearner's
+    # ReduceScatter + per-worker subset search.
+    sharded = (cfg.axis_name is not None and cfg.parallel_mode == "data"
+               and cfg.split_search == "sharded")
 
     def psum(x):
         """Row-sharded reduction; identity in feature-parallel mode
@@ -1089,9 +1254,15 @@ def _grow_compact_impl(cfg: GrowConfig,
         """Histogram reduction: identity for feature-parallel (every
         device holds all rows, so a local histogram is already global)
         AND for voting (the cache stays local; the reduction happens
-        per-search over elected features only)."""
+        per-search over elected features only); a reduce-scatter to
+        this device's owned chunk under the sharded split search.
+        (``_rs_pad``/``Fsp`` are assigned below, before any call —
+        closures bind late.)"""
         if cfg.axis_name is None or fp or vp:
             return x
+        if sharded:
+            return comms.hist_reduce_scatter(_rs_pad(x), cfg.axis_name,
+                                             qm)
         return comms.hist_allreduce(x, cfg.axis_name, qm)
 
     def hist_psum_ef(x, ef):
@@ -1102,9 +1273,13 @@ def _grow_compact_impl(cfg: GrowConfig,
         (comms.hist_allreduce docstring). ``ef`` passes through
         untouched when the wire is exact f32 — and no reduction at all
         happens under feature/voting parallelism (a local histogram is
-        already the one the search consumes)."""
+        already the one the search consumes). Sharded search: the
+        reduction is the EF-threaded reduce-scatter, and the result is
+        this device's chunk."""
         if fp or vp:
             return x, ef
+        if sharded:
+            return _sh_psum_ef(x, ef)
         return _psum_ef(x, ef)
 
     has_mono = monotone_constraints is not None
@@ -1139,33 +1314,43 @@ def _grow_compact_impl(cfg: GrowConfig,
          end_at, bundle_nanpos, bundle_nan_at) = bundle_arrays
 
     def _fp_combine(r: SplitResult) -> SplitResult:
-        """SyncUpGlobalBestSplit (parallel_tree_learner.h:209-232):
-        allreduce the max-gain SplitInfo across the disjoint feature
-        shards; ties resolve to the lower feature id (SplitInfo total
-        order, split_info.hpp)."""
-        ax = cfg.axis_name
-        gmax = lax.pmax(r.gain, ax)
-        at_max = r.gain >= gmax
-        packed = jnp.where(at_max, r.feature, jnp.int32(2 ** 30))
-        fwin = lax.pmin(packed, ax)
-        win = at_max & (r.feature == fwin)
-        cnt = lax.psum(win.astype(jnp.float32), ax)
-
-        def bc(x):
-            xf = x.astype(jnp.float32)
-            mean = lax.psum(jnp.where(win, xf, 0.0), ax) / cnt
-            if x.dtype == jnp.bool_:
-                return mean > 0.5
-            if jnp.issubdtype(x.dtype, jnp.integer):
-                return jnp.round(mean).astype(x.dtype)
-            return mean.astype(x.dtype)
-
-        return SplitResult(*(bc(field) for field in r))
+        """SyncUpGlobalBestSplit over disjoint per-device feature
+        subsets (module-level :func:`_combine_split_infos`)."""
+        return _combine_split_infos(r, cfg.axis_name)
 
     def best_for(hist, sg, sh, sc, extra_mask=None, gain_penalty=None,
                  parent_output=None, depth=None, bounds=None):
         fmask = feature_mask if extra_mask is None \
             else feature_mask & extra_mask
+        if sharded:
+            # sharded split search: the reduce-scattered chunk covers
+            # features [f_start, f_start + Fl); slice every per-feature
+            # input to the window, search locally, globalize the
+            # winner's feature id and allreduce the SplitInfo
+            # (SyncUpGlobalBestSplit) — the same search sharding the
+            # feature-parallel mode uses, fed by scattered rows;
+            # ``ssl`` is _make_sharded_search's owned_slice
+            owned = (f_start + jnp.arange(Fl)) < F
+            if bounds is not None and len(bounds) == 6:
+                # advanced monotone: slice the per-[F, B] bound arrays
+                # to this device's feature window
+                def bsl(b):
+                    if Fsp > F:
+                        b = jnp.concatenate(
+                            [b, jnp.zeros((Fsp - F, B), b.dtype)])
+                    return lax.dynamic_slice(b, (f_start, 0), (Fl, B))
+
+                bounds = tuple(bsl(b) for b in bounds[:4]) + bounds[4:]
+            r = find_best_split(hist, sg, sh, sc,
+                                ssl(feat_num_bins, 1),
+                                ssl(feat_nan_bin, -1),
+                                ssl(fmask, False) & owned, p,
+                                ssl(monotone_constraints, 0),
+                                ssl(feat_is_cat, False),
+                                ssl(gain_penalty, 0.0),
+                                parent_output, depth, bounds)
+            r = r._replace(feature=r.feature + f_start)
+            return _fp_combine(r)
         if bundled and not vp:
             b_member, b_tloc = member_at, tloc_at
             b_end, b_nanpos, b_nan = end_at, bundle_nanpos, bundle_nan_at
@@ -1556,9 +1741,18 @@ def _grow_compact_impl(cfg: GrowConfig,
 
         def _fp_owner(f):
             return jnp.minimum(f // Fl, D_fp - 1)
+    elif sharded:
+        # sharded-search ownership windows: DISJOINT equal chunks over
+        # a D*ceil(F/D)-padded feature axis (psum_scatter needs equal
+        # chunks; unlike fp's word-aligned clamped windows there is no
+        # packing constraint — the hist is built at full width and
+        # scattered, so a plain ceil split keeps ownership exact)
+        Fl, Fsp, f_start, dev_idx, _rs_pad, _sh_psum_ef, ssl = \
+            _make_sharded_search(cfg, F, qm, use_ef)
     else:
         Fl = F
-    FH = Fl if fp else F                          # hist feature count
+    FB = Fl if fp else F       # hist BUILD feature count (local pass)
+    FH = Fl if (fp or sharded) else F   # hist CACHE/search feature count
 
     def chunk_goleft(col, f, t, dl, isc, cm):
         """go-left decision for one chunk given the SPLIT column's bins
@@ -1795,7 +1989,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         src_base = src * SEG + PAD + start
         dst_base = (1 - src) * SEG + PAD + start
         zero = jnp.asarray(0, jnp.int32)
-        acc0 = jnp.zeros((FH, B, C), jnp.int32 if quant else dtype)
+        acc0 = jnp.zeros((FB, B, C), jnp.int32 if quant else dtype)
 
         def write(arr, off, block, m):
             """Masked RMW block write at a dynamic row offset."""
@@ -2027,7 +2221,7 @@ def _grow_compact_impl(cfg: GrowConfig,
         Out-of-bag rows carry zero payload (w folded into pay2), so no
         extra masking beyond the window tail is needed."""
         src_base = src * SEG + PAD + start
-        acc0 = jnp.zeros((FH, B, C), jnp.int32 if quant else dtype)
+        acc0 = jnp.zeros((FB, B, C), jnp.int32 if quant else dtype)
 
         def make_body(CK, base_off):
             def body(c, acc):
@@ -2063,11 +2257,21 @@ def _grow_compact_impl(cfg: GrowConfig,
     root_rows = _local_hist_rows(bins_pk, jnp.asarray(0, jnp.int32),
                                  n) if fp else bins_rm
     total_c = psum(jnp.sum(inbag.astype(dtype)))
-    comm_ef0 = jnp.zeros((FH, B, C), dtype) if use_ef else ()
+    comm_ef0 = jnp.zeros((Fsp if sharded else FB, B, C),
+                         dtype) if use_ef else ()
     if quant:
         root_hist = hist_psum(hist_from_rows_int(root_rows, gw2_q, B,
                                                  hmethod))
-        sums = hist_f(root_hist)[0].sum(axis=0)  # every row hits feature 0
+        if sharded:
+            # the GLOBAL feature-0 row lives on device 0's chunk only;
+            # broadcast it (exact int32 psum of one contributor) and
+            # sum the same bin sequence the gathered path sums
+            row0 = lax.psum(
+                jnp.where(dev_idx == 0, root_hist[0],
+                          jnp.zeros_like(root_hist[0])), cfg.axis_name)
+            sums = (row0.astype(dtype) * scale2[None, :]).sum(axis=0)
+        else:
+            sums = hist_f(root_hist)[0].sum(axis=0)  # row hits feature 0
         if vp:
             # voting keeps the cache local; the root tuple is global
             sums = lax.psum(sums, cfg.axis_name)
@@ -2246,7 +2450,18 @@ def _grow_compact_impl(cfg: GrowConfig,
                 hist = lax.dynamic_index_in_dim(hists, l,
                                                 keepdims=False)
             hf = hist_f(hist)
-            sums = hf[0].sum(axis=0)
+            if sharded:
+                # leaf totals from the GLOBAL feature-0 row (device
+                # 0's chunk), broadcast with one [B, 2] psum so every
+                # device sums the bit-identical bin sequence the
+                # gathered path sums (hf[0] on a chunk is a different
+                # feature per device — same total, different last-ulp)
+                row0 = lax.psum(
+                    jnp.where(dev_idx == 0, hf[0], jnp.zeros_like(hf[0])),
+                    cfg.axis_name)
+                sums = row0.sum(axis=0)
+            else:
+                sums = hf[0].sum(axis=0)
             mask_l, pen_l, bounds_l = _leaf_mask_pen_bounds(
                 tree, branch, cegb_st, mono_st, nmask_st, l)
             r = best_for(hf, sums[0], sums[1], tree.leaf_count[l],
@@ -2270,7 +2485,14 @@ def _grow_compact_impl(cfg: GrowConfig,
             return _research_leafwise(tree, hists, branch, cegb_st,
                                       mono_st, nmask_st, pool_ctx)
         hf = jax.vmap(hist_f)(hists)              # [L, F, B, 2]
-        sums = hf[:, 0].sum(axis=1)               # [L, 2]
+        if sharded:
+            # global feature-0 rows via device 0 (see _research_leafwise)
+            row0 = lax.psum(
+                jnp.where(dev_idx == 0, hf[:, 0],
+                          jnp.zeros_like(hf[:, 0])), cfg.axis_name)
+            sums = row0.sum(axis=1)               # [L, 2]
+        else:
+            sums = hf[:, 0].sum(axis=1)           # [L, 2]
         in_axes = [0, 0, 0, 0]
         args = [hf, sums[:, 0], sums[:, 1], tree.leaf_count]
         masks = None if interaction_groups is None \
@@ -2572,6 +2794,7 @@ def _grow_compact_impl(cfg: GrowConfig,
             # cost_effective_gradient_boosting.hpp:100-124); we hold the
             # per-leaf histograms in HBM, so an exact re-search of every
             # leaf under the updated penalty is the same result.
+            # tpulint: replicated-cond first_use derives from the replicated best-split record on globally-reduced histograms
             best = lax.cond(
                 first_use,
                 lambda b: research_all(tree, hists, branch, cegb_st,
@@ -2603,15 +2826,25 @@ def _grow_compact_impl(cfg: GrowConfig,
         Missing values route right (default_left=False). ``tc`` is the
         leaf's exact count; child counts are hessian-ratio estimates
         like the regular search (feature_histogram.hpp:528)."""
-        totals = jnp.sum(hist[0], axis=0)          # every row hits feat 0
+        if sharded:
+            # the GLOBAL feature-0 row lives on device 0's chunk only
+            # (see _research_leafwise) — broadcast, then sum the same
+            # bin sequence the gathered path sums
+            row0 = lax.psum(
+                jnp.where(dev_idx == 0, hist[0], jnp.zeros_like(hist[0])),
+                cfg.axis_name)
+            totals = jnp.sum(row0, axis=0)
+        else:
+            totals = jnp.sum(hist[0], axis=0)      # every row hits feat 0
         tg, th = totals[0], totals[1]
         # the histogram COLUMN the forced feature lives in: its own
         # column when plain, its bundle column under EFB
         fcol = bundle_of[f] if bundled else f
-        if fp:
+        if fp or sharded:
             # the forced column's histogram lives on its owner device
             # only; route it to everyone with one [B, 2] psum
-            own = _fp_owner(fcol) == dev_idx
+            own = (_fp_owner(fcol) == dev_idx) if fp else \
+                (fcol >= f_start) & (fcol < f_start + Fl)
             lf = jnp.clip(fcol - f_start, 0, Fl - 1)
             h_loc = lax.dynamic_index_in_dim(hist, lf, keepdims=False)
             h = lax.psum(jnp.where(own, h_loc, 0.0), cfg.axis_name)

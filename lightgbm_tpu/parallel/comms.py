@@ -78,8 +78,10 @@ from jax import lax
 
 __all__ = [
     "BLOCK", "QMAX", "WIRE_ITEMSIZE", "hist_allreduce",
-    "make_hist_psum_ef", "resolve_hist_comm", "payload_elems",
-    "payload_bytes", "choose_parallel_mode", "collective_payloads",
+    "hist_reduce_scatter", "make_hist_psum_ef",
+    "resolve_hist_comm", "payload_elems", "payload_bytes",
+    "splitinfo_elems", "post_reduction_elems", "post_reduction_bytes",
+    "choose_parallel_mode", "collective_payloads",
     "jaxpr_collective_payloads",
 ]
 
@@ -325,6 +327,98 @@ def make_hist_psum_ef(axis_name, hist_comm: str, quantize: bool = True):
 
 
 # ---------------------------------------------------------------------
+# the reduce-scatter primitive (sharded split search)
+# ---------------------------------------------------------------------
+
+def hist_reduce_scatter(x: jnp.ndarray, axis_name, mode: str = "f32",
+                        error_feedback: Optional[jnp.ndarray] = None,
+                        scatter_axis: int = 0):
+    """Reduce ``x`` across ``axis_name`` and return only THIS device's
+    chunk of ``scatter_axis`` — the reference data-parallel learner's
+    ``ReduceScatter`` (network.h) as a first-class wire primitive for
+    ``split_search="sharded"``: each device then searches its owned
+    ``F/D`` feature chunk instead of the full gathered histogram, and
+    only the tiny winning SplitInfo records travel afterwards.
+
+    ``x.shape[scatter_axis]`` must be ``D * chunk``.
+
+    - ``mode="f32"`` (and any non-floating ``x``, e.g. exact int32
+      quantized-gradient histograms): ``lax.psum_scatter`` — its chunk
+      is bit-identical to the matching slice of ``lax.psum`` (on CPU by
+      construction of the ordered reduction; on TPU the ring allreduce
+      IS reduce-scatter + all-gather), which is what makes
+      sharded-search split decisions byte-identical to the gathered
+      path's.
+    - ``"int8"``/``"int16"``: the int-wire exchange's phase 1
+      (all_to_all of per-block-quantized payloads, scales packed into
+      the same integer buffer) followed by the owner REQUANTIZING its
+      reduced chunk and consuming the dequantized result — the same
+      bytes the gathered exchange's phase-2 all_gather would have
+      broadcast, minus the broadcast. Blocks are laid out per device
+      chunk (each chunk padded to a BLOCK multiple independently), so
+      chunk ownership aligns with ``scatter_axis`` slices exactly.
+
+    With ``error_feedback`` (full ``x`` shape) the return is
+    ``(chunk, new_error_feedback)`` — the residual covers the whole
+    local histogram plus this rank's phase-2 requantization error on
+    its owned chunk, telescoping like :func:`hist_allreduce`'s.
+    Replication: every device's chunk is a pure function of the
+    globally-reduced histogram, and downstream SplitInfo combines are
+    allreduces — so split decisions stay identical on every rank.
+    """
+    has_ef = error_feedback is not None
+
+    def ret(y, ef):
+        return (y, ef) if has_ef else y
+
+    if axis_name is None:
+        return ret(x, error_feedback)
+    if mode not in ("int8", "int16") \
+            or not jnp.issubdtype(x.dtype, jnp.floating):
+        chunk = lax.psum_scatter(x, axis_name,
+                                 scatter_dimension=scatter_axis,
+                                 tiled=True)
+        return ret(chunk, error_feedback)
+    D = _axis_size(axis_name)
+    if D == 1:
+        return ret(x, error_feedback)
+
+    qmax = QMAX[mode]
+    wire_dtype = _WIRE_DTYPE[mode]
+    dtype = x.dtype
+    xe = x if not has_ef else x + error_feedback
+    xm = jnp.moveaxis(xe, scatter_axis, 0)
+    cs = xm.shape[0] // D                    # chunk rows
+    per = xm.size // D                       # elements per chunk
+    flat = xm.reshape(D, per)
+    pad = (-per) % BLOCK
+    fl = jnp.pad(flat, ((0, 0), (0, pad)))   # [D, per + pad]
+    cb = (per + pad) // BLOCK
+    blocks = fl.reshape(D * cb, BLOCK)
+    q, scale = _quantize(blocks, qmax, wire_dtype)
+    pk = _pack_scales(q, scale, wire_dtype)  # [D*cb, BLOCK+s]
+    px = lax.all_to_all(pk.reshape(D, cb, pk.shape[1]), axis_name,
+                        split_axis=0, concat_axis=0)  # [D, cb, BLOCK+s]
+    qx, sx = _unpack_scales(px, wire_dtype)
+    red = jnp.sum(qx.astype(dtype) * sx[..., None], axis=0)  # [cb, BLOCK]
+    q2, scale2 = _quantize(red, qmax, wire_dtype)
+    deq2 = q2.astype(dtype) * scale2[:, None]
+    chunk = deq2.reshape(-1)[:per].reshape((cs,) + xm.shape[1:])
+    chunk = jnp.moveaxis(chunk, 0, scatter_axis)
+    if not has_ef:
+        return chunk
+    sent = q.astype(dtype) * scale[:, None]          # [D*cb, BLOCK]
+    ef_full = (blocks - sent).reshape(D, per + pad)[:, :per]
+    err2 = (red - deq2).reshape(-1)[:per]            # own-chunk requant
+    own = lax.axis_index(axis_name)
+    cur = lax.dynamic_index_in_dim(ef_full, own, keepdims=False)
+    ef_full = lax.dynamic_update_index_in_dim(ef_full, cur + err2, own,
+                                              axis=0)
+    new_ef = jnp.moveaxis(ef_full.reshape(xm.shape), 0, scatter_axis)
+    return chunk, new_ef
+
+
+# ---------------------------------------------------------------------
 # payload model (seeds dryrun_multichip's accounting AND the auto
 # tree_learner choice)
 # ---------------------------------------------------------------------
@@ -363,6 +457,51 @@ def payload_bytes(mode: str, F: int, B: int, hist_comm: str = "f32",
         return elems * 4
     scales = -(-elems // BLOCK) * 4
     return elems * WIRE_ITEMSIZE[hist_comm] + scales
+
+
+def splitinfo_elems(B: int) -> int:
+    """Elements of ONE SplitInfo allreduce record: the scalar fields
+    plus the ``[B]`` categorical membership mask — the same ``2B``
+    bound the feature-parallel payload model uses."""
+    return 2 * B
+
+
+def post_reduction_elems(mode: str, F: int, B: int, D: int = 1,
+                         split_search: str = "gathered",
+                         top_k: int = 20) -> int:
+    """POST-reduction split-search payload per device (ELEMENTS): what
+    each device RECEIVES after the reduce phase, per split search.
+
+    - ``gathered`` data-parallel: the full ``[F, B, 2]`` reduced
+      histogram is broadcast back to every device (the all-gather arm
+      of the ring allreduce).
+    - ``sharded`` data-parallel (``split_search="sharded"``): each
+      device receives only its owned ``ceil(F/D)`` feature chunk from
+      the reduce-scatter, plus the ``O(D)`` per-device best-SplitInfo
+      records of the combine.
+    - other modes: unchanged from :func:`payload_elems` (voting's
+      elected buffer / feature's SplitInfo are already small).
+    """
+    if mode == "data" and split_search == "sharded" and D > 1:
+        return -(-F // D) * B * 2 + D * splitinfo_elems(B)
+    return payload_elems(mode, F, B, top_k)
+
+
+def post_reduction_bytes(mode: str, F: int, B: int, D: int = 1,
+                         split_search: str = "gathered",
+                         hist_comm: str = "f32", top_k: int = 20) -> int:
+    """Dtype-aware wire BYTES of :func:`post_reduction_elems`. The
+    histogram part scales with ``hist_comm`` (chunk or full broadcast);
+    SplitInfo records stay f32 by design."""
+    if mode == "data" and split_search == "sharded" and D > 1:
+        chunk = -(-F // D) * B * 2
+        if hist_comm in ("int8", "int16"):
+            scales = -(-chunk // BLOCK) * 4
+            hist_b = chunk * WIRE_ITEMSIZE[hist_comm] + scales
+        else:
+            hist_b = chunk * 4
+        return hist_b + D * splitinfo_elems(B) * 4
+    return payload_bytes(mode, F, B, hist_comm, top_k)
 
 
 def resolve_hist_comm(hist_comm: str, F: int, B: int,
@@ -452,6 +591,18 @@ def jaxpr_collective_payloads(closed):
     def _walk(jaxpr):
         for eqn in jaxpr.eqns:
             if eqn.primitive.name in COLLECTIVE_PRIMS:
+                # output side too: a psum RETURNS the full reduced
+                # operand where a psum_scatter returns 1/D of it — the
+                # out bytes are the post-reduction payload the sharded
+                # split search exists to shrink
+                out_elems = out_bytes = 0
+                for v in eqn.outvars:
+                    aval = getattr(v, "aval", None)
+                    if aval is None or not hasattr(aval, "size"):
+                        continue
+                    out_elems += int(aval.size)
+                    out_bytes += int(aval.size) \
+                        * int(jnp.dtype(aval.dtype).itemsize)
                 for v in eqn.invars:
                     aval = getattr(v, "aval", None)
                     if aval is None or not hasattr(aval, "size"):
@@ -462,6 +613,8 @@ def jaxpr_collective_payloads(closed):
                         "elems": int(aval.size),
                         "itemsize": int(itemsize),
                         "bytes": int(aval.size) * int(itemsize),
+                        "elems_out": out_elems,
+                        "bytes_out": out_bytes,
                     })
             for val in eqn.params.values():
                 for sub in _sub(val):

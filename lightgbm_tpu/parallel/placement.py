@@ -1,0 +1,283 @@
+"""Device-resident sharded dataset placement (``ShardPlan``).
+
+The out-of-core ingest path (lightgbm_tpu/data/, docs/DATA.md) stopped
+the dense float matrix from ever existing; this module removes the next
+copy up the ladder: with ``Config.shard_residency="device"`` each
+host's binned rows are laid **directly into their ``NamedSharding``
+mesh slice** via ``jax.make_array_from_single_device_arrays``, and the
+host copy is freed after the upload — so the global binned matrix
+never sits whole in any single host's RAM (docs/SHARDING.md). This is
+the device-side completion of the reference's distributed DatasetLoader
+story (dataset_loader.cpp two-round load: every rank ends up holding
+only its partition), re-expressed over a JAX mesh.
+
+Topologies:
+
+- **single-controller mesh** (one process, N local devices — including
+  the virtual-CPU test worlds): every device's slice is cut from this
+  host's matrix; the assembled global array is fully addressable.
+- **multi-controller mesh** (one process per host on a pod): each
+  process cuts slices only for its *addressable* mesh devices; the
+  assembled array is the usual multi-host global jax.Array. The rows
+  this process must hold are exactly its mesh slice — pair with
+  ``spmd.distributed_dataset``, whose device-residency mode keeps each
+  rank's binned shard local instead of allgathering the global matrix.
+
+Every rank joins :func:`upload_barrier` after placing its shards — a
+watchdog-guarded host collective (hostsync), so a host that died
+mid-upload surfaces as an attributable error at a named sync point
+instead of a hang in the first training collective. The barrier is
+rank-invariant by construction (every rank joins unconditionally);
+tpulint TPL007 holds that invariant at review time.
+
+The checkpoint layer uses :func:`fetch_global` /
+:func:`shard_fingerprints` to save a sharded score matrix: the
+snapshot always stores the assembled ``[K, n]`` host matrix (so resume
+works across residency modes), plus one sha256 per device shard so a
+re-placed score can be proven equal to what was saved
+(resilience/checkpoint.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["ShardPlan", "place_rows", "upload_barrier",
+           "fetch_addressable", "fetch_global", "shard_fingerprints",
+           "host_bytes_gauge"]
+
+
+class ShardPlan:
+    """Row layout of one global array over a 1-D mesh's data axis.
+
+    ``n_global`` rows (caller-padded to a device-count multiple) are
+    split into ``D`` equal contiguous shards in mesh-device order;
+    shard ``d`` covers rows ``[d * rows_per_shard, (d+1) *
+    rows_per_shard)``. The plan knows which shards are addressable
+    from this process and builds the global array from per-device
+    uploads of exactly those rows."""
+
+    def __init__(self, mesh, n_global: int):
+        devices = list(np.ravel(mesh.devices))
+        if n_global % len(devices) != 0:
+            raise ValueError(
+                f"ShardPlan needs n_global ({n_global}) divisible by "
+                f"the mesh size ({len(devices)}); pad the rows first "
+                "(parallel.mesh.pad_rows)")
+        self.mesh = mesh
+        self.axis_name = mesh.axis_names[0]
+        self.n_global = int(n_global)
+        self.devices = devices
+        self.rows_per_shard = self.n_global // len(devices)
+
+    def local_shards(self):
+        """(device, global_lo, global_hi) for each shard addressable
+        from this process, in mesh order."""
+        out = []
+        for d, dev in enumerate(self.devices):
+            if dev.process_index != _process_index():
+                continue
+            lo = d * self.rows_per_shard
+            out.append((dev, lo, lo + self.rows_per_shard))
+        return out
+
+    def place(self, host_rows, row_axis: int = 0,
+              local_offset: int = 0, exclusive_rows: bool = False):
+        """Assemble the global device-resident array from this host's
+        ``host_rows`` (numpy; rows on ``row_axis``).
+
+        ``host_rows`` holds the global rows ``[local_offset,
+        local_offset + host_rows.shape[row_axis])`` — the whole matrix
+        on a single-controller mesh (``local_offset=0``), or just this
+        rank's shard on a multi-controller one. Rows of a local mesh
+        slice that the host matrix does not cover (row padding, or
+        rows another rank also holds) are zero-filled.
+
+        ``exclusive_rows=True`` declares that NO other rank holds
+        these rows (the distributed_dataset keep-local path): every
+        held row must then land inside this rank's own device windows
+        — one outside would be zero-filled by another rank's pad and
+        silently corrupt histograms, so place() refuses instead."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        host_rows = np.asarray(host_rows)
+        gshape = list(host_rows.shape)
+        gshape[row_axis] = self.n_global
+        n_have = host_rows.shape[row_axis]
+        blocks = []
+        covered = 0
+        for dev, lo, hi in self.local_shards():
+            # global rows [cov_lo, cov_hi) of this shard are covered
+            # by the host matrix; the rest (row padding / rows another
+            # rank holds) zero-fill. Both bounds stay clamped inside
+            # [lo, hi] so a shard with NO overlap (all padding, or
+            # rows another rank holds) yields an empty block and a
+            # full-width pad instead of negative pad widths.
+            cov_lo = min(max(lo, local_offset), hi)
+            cov_hi = min(max(min(hi, local_offset + n_have), cov_lo),
+                         hi)
+            covered += cov_hi - cov_lo
+            sl = [slice(None)] * host_rows.ndim
+            sl[row_axis] = slice(
+                min(max(cov_lo - local_offset, 0), n_have),
+                min(max(cov_hi - local_offset, 0), n_have))
+            block = host_rows[tuple(sl)]
+            if cov_hi - cov_lo != hi - lo:
+                pad = [(0, 0)] * host_rows.ndim
+                pad[row_axis] = (cov_lo - lo, hi - cov_hi)
+                block = np.pad(block, pad)
+            blocks.append((dev, block))
+        if exclusive_rows and covered != n_have:
+            # only THIS process holds these rows — any held row
+            # outside its own device windows would be zero-filled by
+            # some other rank's pad and silently corrupt histograms
+            raise ValueError(
+                f"ShardPlan.place: process {_process_index()} holds "
+                f"global rows [{local_offset}, {local_offset + n_have}"
+                f") but its device slices cover only {covered} of "
+                f"those {n_have} rows — per-rank row counts must be a "
+                f"whole number of device slices ({self.rows_per_shard}"
+                " rows each); pad every rank's shard (weight-0 rows) "
+                "so n_local is a multiple of rows_per_shard")
+        spec = [None] * host_rows.ndim
+        spec[row_axis] = self.axis_name
+        sharding = NamedSharding(self.mesh, P(*spec))
+        arrays = [jax.device_put(np.ascontiguousarray(block), dev)
+                  for dev, block in blocks]
+        return jax.make_array_from_single_device_arrays(
+            tuple(gshape), sharding, arrays)
+
+
+def _process_index() -> int:
+    import jax
+
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def place_rows(mesh, host_rows, row_axis: int = 0, pad: int = 0):
+    """One-shot :class:`ShardPlan` placement for the single-controller
+    case: shard ``host_rows`` (its ``row_axis`` extended by ``pad``
+    zero rows) over ``mesh``'s data axis and return the global
+    device-resident array. Multi-controller callers build a
+    :class:`ShardPlan` with the global row count and pass their
+    ``local_offset``."""
+    host_rows = np.asarray(host_rows)
+    plan = ShardPlan(mesh, int(host_rows.shape[row_axis]) + int(pad))
+    return plan.place(host_rows, row_axis=row_axis)
+
+
+def upload_barrier(what: str = "placement/upload_barrier") -> None:
+    """Post-upload world sync: every rank joins unconditionally (never
+    rank-guard this call — a rank that skips it deadlocks the world;
+    TPL007). Single-process worlds return immediately."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from .hostsync import host_allgather
+
+    host_allgather(np.asarray([_process_index()], np.int64), what)
+
+
+def fetch_addressable(arr) -> np.ndarray:
+    """Host value of a fully-addressable (numpy / single-controller)
+    array — never a collective. A multi-controller global array raises:
+    assemble those with :func:`fetch_global`, a world collective every
+    rank must join — callers that rank-gate their work (checkpoint
+    writes) must hoist that gather above the gate and pass the result
+    down."""
+    if isinstance(arr, np.ndarray):
+        return arr
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    raise RuntimeError(
+        "fetch_addressable: the array is not fully addressable from "
+        "this process; assemble it with placement.fetch_global (a "
+        "world collective — every rank must join) and pass the host "
+        "matrix down")
+
+
+def fetch_global(arr) -> np.ndarray:
+    """The full host value of a possibly-sharded array.
+
+    numpy / fully-addressable jax arrays: one ``np.asarray``. A
+    multi-controller global array is assembled from this process's
+    addressable shards allgathered over the host transport (every rank
+    joins — the sharded-checkpoint gather named by docs/SHARDING.md);
+    ranks hold identical results afterwards, so rank 0 can write the
+    snapshot for all."""
+    if isinstance(arr, np.ndarray) \
+            or getattr(arr, "is_fully_addressable", True):
+        return fetch_addressable(arr)
+    from .hostsync import host_allgather
+
+    # gather only this rank's shard DATA plus tiny index bounds — not
+    # a full-array-shaped buffer per rank (at [K, n] f32 score scale
+    # that would ship P x the whole matrix through the host transport
+    # per snapshot). Same-index local shards (replication within a
+    # rank) collapse to one contribution, mirroring cross-rank
+    # replication raising below.
+    uniq = {}
+    for sh in arr.addressable_shards:
+        uniq.setdefault(str(sh.index), sh)
+    shards = [uniq[k] for k in sorted(uniq)]
+    blocks = [np.ascontiguousarray(np.asarray(sh.data))
+              for sh in shards]
+    if len({b.shape for b in blocks}) != 1:
+        raise RuntimeError(
+            "placement.fetch_global: unequal local shard shapes — "
+            "only equal-partition NamedSharding layouts are supported")
+    bounds = np.asarray(
+        [[(sl.start or 0,
+           sl.stop if sl.stop is not None else dim)
+          for sl, dim in zip(sh.index, arr.shape)]
+         for sh in shards], np.int64)              # [S, ndim, 2]
+    gdata = host_allgather(np.stack(blocks),
+                           "placement/checkpoint_gather")
+    gidx = host_allgather(bounds, "placement/checkpoint_gather_idx")
+    out = np.zeros(arr.shape, arr.dtype)
+    count = np.zeros(arr.shape, np.uint8)          # local, never sent
+    for p in range(gdata.shape[0]):
+        for s in range(gdata.shape[1]):
+            sl = tuple(slice(int(a), int(b)) for a, b in gidx[p, s])
+            out[sl] = gdata[p, s]
+            count[sl] += 1
+    if (count == 0).any() or (count > 1).any():
+        raise RuntimeError(
+            "placement.fetch_global: shard covers do not tile the "
+            "array exactly (a rank is missing or shards overlap)")
+    return out
+
+
+def shard_fingerprints(arr) -> Optional[List[dict]]:
+    """One ``{"index", "sha256"}`` per addressable shard of ``arr``
+    (device order), or None for unsharded/host arrays — the
+    per-rank/per-device identity the checkpoint stores so a re-placed
+    sharded score can be proven byte-equal to what was saved."""
+    shards = getattr(arr, "addressable_shards", None)
+    if shards is None or len(shards) <= 1:
+        return None
+    out = []
+    for sh in sorted(shards, key=lambda s: str(s.index)):
+        h = hashlib.sha256(
+            np.ascontiguousarray(np.asarray(sh.data)).tobytes())
+        out.append({"index": str(sh.index), "sha256": h.hexdigest()})
+    return out
+
+
+def host_bytes_gauge(nbytes: int) -> None:
+    """Publish the host-resident binned-matrix footprint (bytes) to
+    the telemetry registry — the measured backing for the "no host
+    holds the global matrix" claim (bench.py --streaming records it)."""
+    try:
+        from ..obs.registry import registry
+        registry.gauge("host_binned_bytes").set(float(nbytes))
+    except Exception:
+        pass

@@ -166,17 +166,38 @@ def distributed_dataset(X, label=None, params: Optional[dict] = None,
     from .hostsync import host_allgather
 
     # an allgather on unequal shard shapes fails with an opaque shape
-    # error (or hangs); check the tiny n_local vector first and name
-    # the mismatched ranks
-    n_locals = host_allgather(
-        np.asarray([ds.num_data()], np.int64),
-        "spmd/dataset_row_counts").reshape(-1)
+    # error (or hangs); check ONE tiny metadata vector first — row
+    # count, group-vector length, and which optional fields each rank
+    # carries — and name the mismatched ranks before any bulk
+    # collective can diverge
+    meta = np.asarray([
+        ds.num_data(),
+        -1 if ds.group is None else len(np.asarray(ds.group)),
+        0 if ds.label is None else 1,
+        0 if ds.weight is None else 1,
+        0 if ds.init_score is None else 1,
+        0 if ds.position is None else 1,
+    ], np.int64)
+    gmeta = host_allgather(meta, "spmd/dataset_meta")      # [P, 6]
+    n_locals = gmeta[:, 0]
     if len(set(n_locals.tolist())) > 1:
         detail = ", ".join(
             f"rank {r}: {int(n)} rows" for r, n in enumerate(n_locals))
         raise LightGBMError(
             "distributed_dataset requires equal row counts per process "
             f"(pad the last shard with weight-0 rows); got {detail}")
+    for name, col in (("group", 1), ("label", 2), ("weight", 3),
+                      ("init_score", 4), ("position", 5)):
+        present = gmeta[:, col] >= (0 if col == 1 else 1)
+        if present.any() and not present.all():
+            have = [r for r in range(len(present)) if present[r]]
+            miss = [r for r in range(len(present)) if not present[r]]
+            raise LightGBMError(
+                f"distributed_dataset: ranks {have} carry {name!r} but "
+                f"ranks {miss} do not — every shard must provide the "
+                "same metadata fields, or the bulk allgather "
+                "deadlocks/misaligns")
+    n_groups = gmeta[:, 1]
 
     if getattr(ds, "_ingest_stats", None) is not None:
         # streaming construct: mappers were synced between its two
@@ -208,17 +229,46 @@ def distributed_dataset(X, label=None, params: Optional[dict] = None,
         g = host_allgather(a, f"spmd/dataset_{what}")  # [P, n_local, ...]
         return np.concatenate(list(g), axis=0)
 
-    ds._bins = gather_rows(local_bins, local_bins.dtype, "bins")
+    # shard residency (parallel/placement.py, docs/SHARDING.md): under
+    # shard_residency=device on a pod (device host-transport), the
+    # BINNED rows are NOT allgathered — each rank keeps only its shard
+    # plus the row offset, and the engine lays it directly into its
+    # NamedSharding mesh slice, so the global binned matrix never
+    # exists on any single host. The kv transport (CPU worlds) still
+    # gathers — there the engine frees the host copy after upload.
+    from ..config import resolve_params
+    from .hostsync import transport
+    residency = str(resolve_params(params).get("shard_residency",
+                                               "auto"))
+    # "auto" resolves to device-residency in the engine whenever a
+    # multi-device mesh runs on an accelerator backend (gbdt.py) —
+    # which a device-transport pod is by construction — so the default
+    # config must keep shards local here too, or the advertised
+    # allgather-skip would only ever fire for an explicit "device"
+    keep_local = residency != "host" and transport() == "device"
+    if keep_local:
+        ds._bins = local_bins
+        ds._local_row_offset = int(jax.process_index()) \
+            * int(n_locals[0])
+    else:
+        ds._bins = gather_rows(local_bins, local_bins.dtype, "bins")
     ds._device_bins = None
-    ds._n = ds._bins.shape[0]
+    ds._n = int(n_locals.sum())
     ds.label = gather_rows(ds.label, np.float64, "label")
     ds.weight = gather_rows(ds.weight, np.float64, "weight")
     ds.init_score = gather_rows(ds.init_score, np.float64, "init_score")
     ds.position = gather_rows(ds.position, np.int32, "position")
     if ds.group is not None:
-        g = host_allgather(np.asarray(ds.group, np.int32),
-                           "spmd/dataset_group")
-        ds.group = np.concatenate(list(g), axis=0)
+        # per-rank GROUP COUNTS legitimately differ (whole query
+        # groups per shard) even with equal row counts; pad every
+        # rank's vector to the max length from the meta gather so the
+        # allgather shapes agree, then strip the -1 padding
+        gmax = int(n_groups.max())
+        gv = np.full((gmax,), -1, np.int32)
+        gv[: int(n_groups[jax.process_index()])] = \
+            np.asarray(ds.group, np.int32)
+        g = host_allgather(gv, "spmd/dataset_group")
+        ds.group = np.concatenate([row[row >= 0] for row in g], axis=0)
         # rebuild the query boundaries for the GLOBAL row set (the
         # shard-local ones from construct() cover only n_local rows)
         ds._query_boundaries = np.concatenate(
@@ -229,8 +279,23 @@ def distributed_dataset(X, label=None, params: Optional[dict] = None,
     # errors instead of silently pairing half a matrix with global
     # labels)
     ds.data = None
-    # a streaming construct's fingerprint covers the LOCAL shard; the
-    # Dataset is global now, so drop it — the checkpoint layer recomputes
-    # from the gathered label/bins (resilience/checkpoint.py)
-    ds._data_digest = None
+    if keep_local:
+        # the checkpoint fingerprint hashes the global label plus the
+        # FIRST 64 binned rows — which live on rank 0's shard only.
+        # Rank 0 computes, everyone joins the broadcast (TPL007: the
+        # rank branch builds only the argument).
+        from .hostsync import host_broadcast_bytes
+        payload = None
+        if jax.process_index() == 0:
+            from ..data.ingest import dataset_digest
+            payload = b"" if ds.label is None else dataset_digest(
+                np.asarray(ds.label, np.float64), ds._bins).encode()
+        buf = host_broadcast_bytes(payload, "spmd/dataset_digest")
+        ds._data_digest = buf.decode() or None
+    else:
+        # a streaming construct's fingerprint covers the LOCAL shard;
+        # the Dataset is global now, so drop it — the checkpoint layer
+        # recomputes from the gathered label/bins
+        # (resilience/checkpoint.py)
+        ds._data_digest = None
     return ds

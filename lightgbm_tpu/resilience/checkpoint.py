@@ -85,9 +85,17 @@ def _rng_restore(rng: np.random.RandomState, keys: np.ndarray,
 # write
 # ---------------------------------------------------------------------
 
-def write_snapshot(directory: str, booster, keep: int = 3) -> str:
+def write_snapshot(directory: str, booster, keep: int = 3,
+                   score_host=None) -> str:
     """Snapshot ``booster`` into ``directory`` atomically; prune old
-    snapshots beyond ``keep``. Returns the snapshot path."""
+    snapshots beyond ``keep``. Returns the snapshot path.
+
+    ``score_host``: the assembled ``[K, n]`` score matrix, required on
+    a multi-controller mesh whose score is globally sharded — the
+    assembly is a world collective (``placement.fetch_global``), so a
+    rank-gated caller (the checkpoint callback writes rank-0-only)
+    must run it on EVERY rank first and pass the result down; this
+    function itself never joins a collective."""
     eng = booster._engine
     if eng is None:
         raise CheckpointError(
@@ -117,6 +125,17 @@ def write_snapshot(directory: str, booster, keep: int = 3) -> str:
     iteration = int(eng.iter_)
     frng_keys, frng_meta = _rng_state_arrays(eng._feature_rng)
     drng_keys, drng_meta = _rng_state_arrays(eng._dart_rng)
+    # a device-resident run holds the score SHARDED over the mesh
+    # (shard_residency=device, parallel/placement.py): the snapshot
+    # always stores the assembled [K, n] host matrix — so resume works
+    # across residency modes — plus one sha256 per device shard, the
+    # identity a re-placed score is verified against at restore
+    # (docs/SHARDING.md)
+    from ..parallel import placement
+    if score_host is None:
+        score_host = placement.fetch_addressable(eng.score)
+    score_host = np.asarray(score_host, np.float32)
+    score_fps = placement.shard_fingerprints(eng.score)
     state = {
         "magic": CHECKPOINT_MAGIC,
         "iteration": iteration,
@@ -134,6 +153,7 @@ def write_snapshot(directory: str, booster, keep: int = 3) -> str:
         "params_fingerprint": _params_fingerprint(booster.params),
         "data_fingerprint": _dataset_fingerprint(eng),
         "stalled": stalled,
+        "score_shard_fingerprints": score_fps,
     }
     buf = io.BytesIO()
     np.savez(
@@ -141,7 +161,7 @@ def write_snapshot(directory: str, booster, keep: int = 3) -> str:
         state_json=np.frombuffer(
             json.dumps(state).encode("utf-8"), np.uint8),
         model_str=np.frombuffer(model_str.encode("utf-8"), np.uint8),
-        score=np.asarray(eng.score, np.float32),
+        score=score_host,
         frng_keys=frng_keys,
         drng_keys=drng_keys,
     )
@@ -180,9 +200,14 @@ def _dataset_fingerprint(eng) -> Dict[str, Any]:
 
 #: params whose drift between write and resume is expected and benign
 #: (the resume target legitimately differs; IO paths don't shape the
-#: model)
+#: model). shard_residency / split_search are model-neutral by
+#: construction (byte-identical trees either way, docs/SHARDING.md),
+#: so resuming a device-resident snapshot on a host-resident run — or
+#: flipping the split search — is a supported topology change, not
+#: drift.
 _FINGERPRINT_IGNORE = {"num_iterations", "input_model", "output_model",
-                       "snapshot_freq", "data", "valid", "output_result"}
+                       "snapshot_freq", "data", "valid", "output_result",
+                       "shard_residency", "split_search"}
 
 
 def _params_fingerprint(params) -> Dict[str, str]:
@@ -330,6 +355,24 @@ def restore_booster(booster, snap: Dict[str, Any]) -> int:
             f"does not match this training set [{eng.K}, {eng.n}] — "
             "was the checkpoint written against different data?")
     eng.preload_models(trees, score=score)
+    # re-placed sharded score (shard_residency=device) must byte-match
+    # what the snapshot saved: when the restored layout matches the
+    # written one, recompute the per-shard sha256s and compare —
+    # resume-equality holds by proof, not assumption. Cross-residency
+    # resumes (device snapshot -> host run or vice versa) skip the
+    # check; the assembled matrix was installed verbatim either way.
+    from ..parallel import placement
+    fps_then = snap.get("score_shard_fingerprints")
+    fps_now = placement.shard_fingerprints(eng.score)
+    if fps_then and fps_now:
+        then = {f["index"]: f["sha256"] for f in fps_then}
+        now = {f["index"]: f["sha256"] for f in fps_now}
+        if set(then) == set(now) and then != now:
+            bad = sorted(k for k in then if then[k] != now[k])
+            raise LightGBMError(
+                f"checkpoint {snap.get('path')}: re-placed score "
+                f"shards differ from the saved ones at {bad} — the "
+                "device placement corrupted the score matrix")
     eng._resume_stalled = bool(snap.get("stalled", False))
     eng._tree_weights = [float(w) for w in snap.get("tree_weights", [])] \
         or [1.0] * len(trees)
@@ -398,13 +441,21 @@ class Checkpoint:
             nproc, rank = jax.process_count(), jax.process_index()
         except Exception:
             nproc, rank = 1, 0
+        score_host = None
         if nproc > 1:
             from ..parallel.spmd import verify_step_consistency
             verify_step_consistency(
                 it, len(eng._models_store) + len(eng._pending_dev))
+            # a globally-sharded score (shard_residency=device on a
+            # multi-controller mesh) is assembled by a WORLD collective
+            # — every rank joins the gather HERE, above the rank gate;
+            # only the file write below is rank-0-only (TPL007)
+            from ..parallel import placement
+            score_host = placement.fetch_global(eng.score)
             if rank != 0:
                 return
-        path = write_snapshot(self.directory, env.model, keep=self.keep)
+        path = write_snapshot(self.directory, env.model, keep=self.keep,
+                              score_host=score_host)
         log_info(f"checkpoint: wrote {path}")
 
 

@@ -1,0 +1,47 @@
+# tpulint fixture: TPL007 negative — the CORRECT placement host-sync
+# shapes (docs/SHARDING.md): every rank joins the barrier and the
+# checkpoint gather unconditionally; only LOCAL work (per-rank slice
+# building, the rank-0 file write) sits behind rank branches.
+import jax
+
+from lightgbm_tpu.parallel.placement import (fetch_addressable,
+                                             fetch_global,
+                                             upload_barrier)
+
+
+def unconditional_upload_barrier(plan, host_rows):
+    """The engine's placement shape: the rank branch builds only the
+    per-rank ARGUMENT (each process places its own slices); the
+    barrier itself is joined by everyone."""
+    offset = 0
+    if jax.process_index() > 0:
+        offset = jax.process_index() * host_rows.shape[0]
+    placed = plan.place(host_rows, local_offset=offset)
+    upload_barrier("ok/everyone_joins")
+    return placed
+
+
+def gather_above_the_rank_gate(score, path):
+    """The PR 2 checkpoint shape done RIGHT: every rank joins the
+    assembly, then only rank 0 writes the file (a local side
+    effect)."""
+    host = fetch_global(score)
+    if jax.process_index() == 0:
+        with open(path, "wb") as fh:
+            fh.write(bytes(host))
+    return host
+
+
+def world_size_gated_barrier():
+    """process_count() is rank-invariant — gating on it is uniform."""
+    if jax.process_count() <= 1:
+        return
+    upload_barrier("ok/world_gate")
+
+
+def addressable_fetch_is_not_a_collective(score):
+    """fetch_addressable never joins a collective by construction —
+    rank-gating it is a plain local read."""
+    if jax.process_index() != 0:
+        return None
+    return fetch_addressable(score)

@@ -1,0 +1,37 @@
+# tpulint fixture: TPL007 positive — the parallel/placement.py
+# host-sync sites (docs/SHARDING.md). The per-rank upload barrier and
+# the sharded-checkpoint gather are world-joining collectives one
+# level above hostsync: rank-guarding a call site skips a world join
+# exactly like skipping the underlying allgather.
+import jax
+
+from lightgbm_tpu.parallel.placement import fetch_global, upload_barrier
+
+
+def rank_gated_upload_barrier(shards):
+    """Only rank 0 joins the post-placement barrier: every other rank
+    sails into the first training collective while rank 0 waits."""
+    if jax.process_index() == 0:
+        # EXPECT: TPL007
+        upload_barrier("bad/rank_gated_upload")
+    return shards
+
+
+def early_return_before_checkpoint_gather(score):
+    """The PR 2 checkpoint shape done WRONG: the rank gate placed
+    above the sharded-score assembly instead of below it — rank 0
+    hangs alone in the gather."""
+    if jax.process_index() != 0:
+        return None
+    # EXPECT: TPL007
+    return fetch_global(score)
+
+
+def gather_in_recovery_handler(score):
+    """Only the ranks that hit the exception join the re-assembly."""
+    try:
+        out = fetch_global(score)
+    except RuntimeError:
+        # EXPECT: TPL007
+        out = fetch_global(score)
+    return out

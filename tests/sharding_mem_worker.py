@@ -1,0 +1,87 @@
+"""Host peak-RSS proof for ``shard_residency=device`` (run per-mode in
+a fresh subprocess by test_sharding.py; one construct+train per
+process so the comparison is a difference of lifetime VmHWM peaks with
+the interpreter baseline cancelling — the test_two_round.py pattern).
+
+The dataset streams in through a generator source (the dense float
+matrix never exists, docs/DATA.md), so the host-side footprints in
+play are the binned matrix and the training buffers:
+
+- ``host`` residency keeps the host numpy bins AND a device copy alive
+  through training — peak carries both plus the training buffers;
+- ``device`` residency frees the host copy right after the mesh upload
+  (parallel/placement.py), so training buffers grow from a floor one
+  binned matrix lower.
+
+Reports one JSON line: ``vmhwm_kb`` (null when /proc omits VmHWM —
+the test skips there), ``bins_mb``, and ``host_binned_bytes`` after
+training (0 under device residency: the measured "no host holds the
+binned matrix" claim).
+
+Usage: python sharding_mem_worker.py <host|device>
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.data import GeneratorChunkSource  # noqa: E402
+
+MODE = sys.argv[1]
+N = 1 << 20
+F = 24
+CHUNK = 1 << 15
+
+
+def chunks():
+    start = 0
+    while start < N:
+        c = min(CHUNK, N - start)
+        rs = np.random.RandomState(start % (2 ** 31 - 1))
+        Xc = rs.randn(c, F).astype(np.float32)
+        yc = (Xc[:, 0] + 0.3 * Xc[:, 1] > 0).astype(np.float64)
+        yield Xc, yc
+        start += c
+
+
+def vmhwm_kb():
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1])
+    except OSError:
+        pass
+    return None
+
+
+def main():
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "bin_construct_sample_cnt": 20000,
+              "ingest_chunk_rows": CHUNK, "min_data_in_leaf": 20,
+              "shard_residency": MODE, "verbosity": -1}
+    src = GeneratorChunkSource(chunks, num_rows=N, num_features=F)
+    ds = lgb.Dataset(src, params=params)
+    ds.construct()
+    bins_mb = ds.host_bins().nbytes / 2 ** 20
+    lgb.train(params, ds, num_boost_round=3)
+    resident = 0 if ds._bins is None else int(ds._bins.nbytes)
+    print(json.dumps({
+        "mode": MODE,
+        "vmhwm_kb": vmhwm_kb(),
+        "bins_mb": round(bins_mb, 1),
+        "host_binned_bytes": resident,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""Worker for the 2-process kv-world sharded-training tests
+(test_sharding.py).
+
+Run as one rank of a 2-process world wired through the environment
+(``LIGHTGBM_TPU_COORDINATOR`` / ``LIGHTGBM_TPU_NUM_PROCS`` /
+``LIGHTGBM_TPU_RANK`` — the elastic_worker.py convention); on CPU the
+host transport resolves to kv, so each process runs the identical
+replicated program over its own 2 virtual devices and the cross-rank
+surface is exactly the host-level sync points.
+
+Modes (argv[2]):
+
+- ``equiv`` — for each data-parallel grower (compact, masked, level),
+  train the gathered/host baseline and the ``shard_residency=device``
+  + ``split_search=sharded`` variant through ``distributed_dataset``;
+  the worker asserts the device run freed its host binned matrix, and
+  rank 0 writes every model string to ``<outdir>/models.json`` for the
+  byte-identity comparison in the test.
+- ``unequal_rows`` — rank 1 drops one row; ``distributed_dataset``
+  must raise a LightGBMError naming both ranks and their row counts
+  BEFORE any bulk collective (the old failure was an opaque allgather
+  shape error).
+- ``unequal_meta`` — rank 0 passes ``weight``, rank 1 does not; the
+  metadata pre-check must name the field and the ranks on both sides.
+
+Usage: python sharding_worker.py <outdir> <mode>
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+outdir = sys.argv[1]
+mode = sys.argv[2]
+
+from lightgbm_tpu.parallel.distributed import init_distributed  # noqa: E402
+
+init_distributed()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.basic import LightGBMError  # noqa: E402
+from lightgbm_tpu.parallel import spmd  # noqa: E402
+
+rank = jax.process_index()
+assert jax.process_count() == 2
+
+rs = np.random.RandomState(11)
+n, f = 800, 11                    # f=11 over 2 devices: uneven chunks
+X = rs.randn(n, f)
+y = ((X[:, 0] + 0.5 * X[:, 1] - 0.2 * X[:, 2]
+      + 0.1 * rs.randn(n)) > 0).astype(np.float64)
+half = n // 2
+lo, hi = rank * half, (rank + 1) * half
+
+
+def shard_ds(**kwargs):
+    return spmd.distributed_dataset(X[lo:hi], label=y[lo:hi],
+                                    params={"verbosity": -1}, **kwargs)
+
+
+def _done_barrier(tag):
+    """Both ranks raise the expected error, but rank 0 hosts the
+    coordination service — an os._exit leaves the peer's error-poll
+    thread mid-RPC and the 'Socket closed' poll result is FATAL
+    (SIGABRT). shutdown() has barrier semantics AND stops the poll
+    thread; both ranks reach it here (same teardown as the healthy
+    path below)."""
+    try:
+        jax.distributed.shutdown()
+    except Exception:
+        pass
+
+
+if mode == "unequal_rows":
+    take = hi - (1 if rank == 1 else 0)
+    try:
+        spmd.distributed_dataset(X[lo:take], label=y[lo:take],
+                                 params={"verbosity": -1})
+    except LightGBMError as e:
+        msg = str(e)
+        assert "rank 0: 400 rows" in msg, msg
+        assert "rank 1: 399 rows" in msg, msg
+        print(f"rank {rank} UNEQUAL_ROWS_OK", flush=True)
+        _done_barrier("test/unequal_rows_done")
+        os._exit(0)
+    print(f"rank {rank} NO ERROR RAISED", flush=True)
+    os._exit(1)
+
+if mode == "unequal_meta":
+    w = np.ones(hi - lo) if rank == 0 else None
+    try:
+        spmd.distributed_dataset(X[lo:hi], label=y[lo:hi], weight=w,
+                                 params={"verbosity": -1})
+    except LightGBMError as e:
+        msg = str(e)
+        assert "'weight'" in msg, msg
+        assert "ranks [0]" in msg and "ranks [1]" in msg, msg
+        print(f"rank {rank} UNEQUAL_META_OK", flush=True)
+        _done_barrier("test/unequal_meta_done")
+        os._exit(0)
+    print(f"rank {rank} NO ERROR RAISED", flush=True)
+    os._exit(1)
+
+assert mode == "equiv", mode
+base = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+        "tree_learner": "data", "num_devices": 2, "seed": 5,
+        "deterministic": True, "verbosity": -1}
+models = {}
+for grower in ("compact", "masked", "level"):
+    p = dict(base, grower=grower)
+    models[f"{grower}/gathered"] = lgb.train(
+        p, shard_ds(), num_boost_round=5).model_to_string()
+
+    p2 = dict(base, grower=grower, shard_residency="device",
+              split_search="sharded")
+    ds2 = shard_ds()
+    models[f"{grower}/sharded"] = lgb.train(
+        p2, ds2, num_boost_round=5).model_to_string()
+    # device residency freed the host binned matrix after the upload
+    assert ds2._bins is None, grower
+    try:
+        ds2.host_bins()
+    except LightGBMError:
+        pass
+    else:
+        raise AssertionError("host_bins() must raise after free")
+
+if rank == 0:
+    with open(os.path.join(outdir, "models.json"), "w") as fh:
+        json.dump(models, fh)
+
+# graceful world teardown: without it the faster rank tears the
+# coordination service down while the other is still mid-training and
+# the survivor's error-poll thread aborts the process (SIGABRT).
+# shutdown() has barrier semantics — every healthy rank reaches it
+# before the service stops (peers are alive here, unlike the chaos
+# workers that must skip teardown).
+print(f"rank {rank} DONE", flush=True)
+sys.stdout.flush()
+try:
+    jax.distributed.shutdown()
+except Exception:
+    pass
+os._exit(0)

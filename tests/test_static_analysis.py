@@ -172,6 +172,7 @@ _FIXTURES = [
     "obs/tpl006_pos.py", "obs/tpl006_neg.py",
     "resilience/tpl006_pos.py", "resilience/tpl006_neg.py",
     "tpl007_pos.py", "tpl007_neg.py",
+    "tpl007_placement_pos.py", "tpl007_placement_neg.py",
     "data/tpl007_pos.py", "data/tpl007_neg.py",
     "obs/tpl008_pos.py", "obs/tpl008_neg.py",
     "obs/tpl008_pragma.py",
@@ -593,6 +594,79 @@ def test_stripping_the_comms_recognizer_blinds_tpl007():
         assert not any(f.symbol == "collective:hist_allreduce"
                        for f in mutated.findings), (
             [f.fid for f in mutated.findings])
+
+
+def test_rank_guarding_the_placement_barrier_fails(tmp_path):
+    """The ISSUE 10 acceptance mutation: gate placement.upload_barrier's
+    world join behind a process_index() early return -> TPL007 with
+    the expected stable id (a rank that skips the barrier deadlocks
+    the post-placement world at the first training collective)."""
+    anchor = ('    host_allgather(np.asarray([_process_index()], '
+              'np.int64), what)')
+    res = _lint_mutated(
+        "parallel/placement.py",
+        lambda src: src.replace(
+            anchor,
+            "    if jax.process_index() != 0:\n        return\n"
+            + anchor),
+        ["TPL007"], tmp_path)
+    fids = [f.fid for f in res.findings]
+    assert ("TPL007:parallel/placement.py:upload_barrier:"
+            "collective:host_allgather#1") in fids, fids
+
+
+def test_rank_gating_the_checkpoint_gather_fails(tmp_path):
+    """Moving the sharded-score assembly BELOW the callback's rank-0
+    gate (the deadlock the hoist in Checkpoint.__call__ exists to
+    avoid) -> TPL007 on the fetch_global call site."""
+    anchor = "            score_host = placement.fetch_global(eng.score)"
+    res = _lint_mutated(
+        "resilience/checkpoint.py",
+        lambda src: src.replace(
+            anchor,
+            "            if rank != 0:\n                return\n"
+            + anchor),
+        ["TPL007"], tmp_path)
+    assert any(f.rule == "TPL007"
+               and f.symbol == "collective:fetch_global"
+               for f in res.findings), [f.fid for f in res.findings]
+
+
+def test_stripping_the_placement_recognizer_blinds_tpl007(tmp_path):
+    """The placement wrapper entries must be load-bearing: with
+    _PLACEMENT_WRAPPERS stripped from the collective set, the
+    rank-guarded barrier mutation above goes dark at the wrapper call
+    site (upload_barrier taken as a plain local call)."""
+    from lightgbm_tpu.analysis.rules_flow import CollectiveOrder
+
+    src = (
+        "import jax\n\n"
+        "from lightgbm_tpu.parallel.placement import upload_barrier\n"
+        "\n\n"
+        "def gated(shards):\n"
+        "    if jax.process_index() == 0:\n"
+        "        upload_barrier('bad/gated')\n"
+        "    return shards\n")
+    path = tmp_path / "placement_host.py"
+    path.write_text(src, encoding="utf-8")
+    res = run_lint(root=str(tmp_path), package="tpulint_fixtures",
+                   files=["placement_host.py"], baseline_path="",
+                   rules=["TPL007"])
+    assert any(f.symbol == "collective:upload_barrier"
+               for f in res.findings), [f.fid for f in res.findings]
+    saved = CollectiveOrder._COLLECTIVES
+    try:
+        CollectiveOrder._COLLECTIVES = \
+            saved - CollectiveOrder._PLACEMENT_WRAPPERS
+        mutated = run_lint(root=str(tmp_path),
+                           package="tpulint_fixtures",
+                           files=["placement_host.py"],
+                           baseline_path="", rules=["TPL007"])
+    finally:
+        CollectiveOrder._COLLECTIVES = saved
+    assert not any(f.symbol == "collective:upload_barrier"
+                   for f in mutated.findings), (
+        [f.fid for f in mutated.findings])
 
 
 def test_threadsafe_pragma_requires_a_reason():
