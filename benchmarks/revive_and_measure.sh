@@ -8,9 +8,12 @@
 #   2. bench.py (Higgs 10.5M)        -> benchmarks/BENCH_LOCAL_r06.json
 #   3. bench.py allstate preset 2M   -> benchmarks/BENCH_ALLSTATE_r06.json
 #   4. benchmarks/fused_iter_bench.py -> benchmarks/FUSED_r06.txt
-#      (the PENDING pallas flip gate: its fused+pallas arm prints the
-#       FLIP/keep verdict that decides hist_method auto on TPU,
-#       docs/PALLAS.md)
+#      (THREE pending flip gates in one run: the fused+pallas arm's
+#       verdict decides hist_method auto on TPU (docs/PALLAS.md), the
+#       fused+scan arm's verdict decides fused_scan_iters auto
+#       (docs/FUSED.md — its dispatch-gap decomposition must also show
+#       inter-iteration host driver time ~ 0 inside a window), and the
+#       eager-vs-fused speedup refreshes the r05 baseline)
 #   5. benchmarks/quant_bench.py --comms -> benchmarks/COMMS_r06.txt
 #      (f32 vs int16 vs int8 histogram allreduce at the Allstate-wide
 #       shape on 8 devices; its verdict gates hist_comm auto -> int8,
@@ -61,10 +64,11 @@ BENCH_PRESET=allstate BENCH_DEADLINE=3000 timeout 3200 python bench.py \
     > benchmarks/BENCH_ALLSTATE_r06.json 2>benchmarks/BENCH_ALLSTATE_r06.err
 log "allstate bench $(bench_status benchmarks/BENCH_ALLSTATE_r06.json): $(cat benchmarks/BENCH_ALLSTATE_r06.json)"
 
-log "step 4/5: fused_iter_bench (pallas flip gate)"
-timeout 2400 python benchmarks/fused_iter_bench.py \
+log "step 4/5: fused_iter_bench (pallas + scan flip gates)"
+timeout 3000 python benchmarks/fused_iter_bench.py \
     > benchmarks/FUSED_r06.txt 2>&1
-log "fused_iter rc=$? verdict: $(grep -a 'pallas vs mxu' benchmarks/FUSED_r06.txt || echo none)"
+log "fused_iter rc=$? pallas verdict: $(grep -a 'pallas vs mxu' benchmarks/FUSED_r06.txt || echo none)"
+log "fused_iter scan verdict: $(grep -a 'scan vs fused' benchmarks/FUSED_r06.txt || echo none)"
 
 log "step 5/5: quant_bench --comms (hist_comm flip gate)"
 timeout 1200 python benchmarks/quant_bench.py --comms \

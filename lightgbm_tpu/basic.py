@@ -1626,10 +1626,23 @@ class Booster:
         if self._engine is not None:
             if "learning_rate" in params:
                 self._engine._shrinkage = float(params["learning_rate"])
+                # the new rate must take effect on the NEXT iteration
+                # (reference semantics) — discard any precomputed
+                # lookahead still scored at the old rate
+                self._engine._abort_scan_window()
             for k in ("bagging_fraction", "bagging_freq",
                       "feature_fraction", "feature_fraction_bynode"):
                 if k in params:
                     setattr(self._engine.cfg, k, params[k])
+                    # the scan-window programs BAKE the bagging
+                    # fractions/freq and key schedules into their
+                    # traced bodies (gbdt._get_scan_fn fresh_bag /
+                    # _StepCtx), unlike the per-iteration fused fn
+                    # whose row weights arrive as operands — drop the
+                    # cache (and any precomputed lookahead) so the
+                    # next window re-traces with the new cfg
+                    self._engine._scan_fns = {}
+                    self._engine._abort_scan_window()
             if "feature_fraction_bynode" in params:
                 # bynode is baked into the traced grow programs (the
                 # per-node key schedule): refresh the static grow
@@ -1646,6 +1659,7 @@ class Booster:
                     gcfg = gcfg._replace(grower="compact")
                 eng.grow_cfg = gcfg
                 eng._fused_fn = None
+                eng._scan_fns = {}
                 if eng._grow_fn is not None:
                     eng._grow_fn = eng._build_grow_fn()
         return self

@@ -420,6 +420,20 @@ class Config:
     # trees (they become no-op constants) and keeps training, "clamp"
     # replaces NaN/Inf with finite values and keeps the trees
     nonfinite_policy: str = "raise"
+    # multi-iteration fused scan (docs/FUSED.md): trace N boosting
+    # iterations into ONE lax.scan program with donated score/bagging
+    # carries and a window-batched tree-pack fetch, deleting the
+    # per-iteration dispatch + host round-trip from the hot loop.
+    # "auto" (default) stays per-iteration until the Higgs-shaped
+    # fused_iter_bench scan arm measures a win on chip
+    # (LIGHTGBM_TPU_AUTO_SCAN_ITERS=N opts auto in for measurement;
+    # LIGHTGBM_TPU_DISABLE_SCAN=1 is the kill switch). An explicit
+    # integer N>1 enables windows of up to N iterations; the engine
+    # shrinks windows to the next checkpoint/end-of-training boundary
+    # and falls back to the per-iteration fused path for configs the
+    # scan cannot carry (feature_fraction host RNG, GOSS/DART, valid
+    # sets — see GBDTBooster._scan_ok)
+    fused_scan_iters: Any = "auto"
 
     # ---- dataset ----
     linear_tree: bool = False
@@ -723,6 +737,19 @@ class Config:
             raise ValueError(
                 f"Unknown nonfinite_policy: {self.nonfinite_policy} "
                 "(expected raise, skip_tree or clamp)")
+        if self.fused_scan_iters != "auto":
+            try:
+                self.fused_scan_iters = int(self.fused_scan_iters)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "fused_scan_iters must be 'auto' or an integer >= 1, "
+                    f"got {self.fused_scan_iters!r}") from None
+            if not 1 <= self.fused_scan_iters <= 1024:
+                raise ValueError(
+                    "fused_scan_iters must be in [1, 1024] (one scan "
+                    "window is one XLA program; larger windows only "
+                    "grow trace time), got "
+                    f"{self.fused_scan_iters}")
         for name in ("serve_max_batch_rows", "serve_min_bucket_rows"):
             v = getattr(self, name)
             if v < 1 or (v & (v - 1)) != 0:

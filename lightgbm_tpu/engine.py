@@ -41,6 +41,49 @@ def _finish_callbacks(callbacks: List[Callable]) -> None:
         if isinstance(cb, callback_mod._Telemetry):
             cb.finish()
 
+
+# callbacks the fused scan window may legally run ahead of: they read
+# no mid-window engine state the pop-per-update driver cannot serve
+# per iteration (tree stats / phases / eval tuples — evaluation forces
+# the eager path anyway, so these are inert on scan-eligible runs).
+# Anything else (reset_parameter, user callbacks) pins the lookahead
+# to 1: a window must never skate past a state read it cannot predict.
+_SCAN_INERT_CALLBACKS = (callback_mod._Telemetry,
+                         callback_mod._LogEvaluation,
+                         callback_mod._RecordEvaluation,
+                         callback_mod._EarlyStopping)
+
+
+def _scan_lookahead(callbacks: List[Callable], iteration: int,
+                    end_iteration: int,
+                    engine_iteration: int,
+                    eval_every: Optional[int] = None) -> int:
+    """How many iterations the multi-iteration fused scan
+    (models/gbdt.py, docs/FUSED.md) may run ahead of the callback loop
+    starting at loop index ``iteration``: never past end-of-training,
+    never past the next checkpoint firing — the Checkpoint callback
+    keys on the engine's ABSOLUTE ``iter_`` (``engine_iteration``;
+    offset from the loop index under init_model continued training),
+    and `it % every_n == 0` reads the score, so windows must END on
+    that cadence so snapshots see committed state — never past the
+    loop's own inline evaluation (``eval_every`` = metric_freq when
+    the train set is evaluated as a valid set; that cadence is
+    loop-indexed), and 1 the moment an unknown callback could observe
+    mid-window state."""
+    from .resilience.checkpoint import Checkpoint
+
+    horizon = end_iteration - iteration
+    if eval_every is not None:
+        every = max(1, int(eval_every))
+        horizon = min(horizon, every - (iteration % every))
+    for cb in callbacks:
+        if isinstance(cb, Checkpoint):
+            every = max(1, int(cb.every_n_iters))
+            horizon = min(horizon, every - (engine_iteration % every))
+        elif not isinstance(cb, _SCAN_INERT_CALLBACKS):
+            return 1
+    return max(1, horizon)
+
 __all__ = ["train", "cv", "CVBooster"]
 
 
@@ -186,6 +229,20 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
         for i in range(begin_iteration, end_iteration):
             fault_plan.maybe_kill(i)
             fault_plan.maybe_distributed_fault(i)
+            if booster._engine is not None:
+                # fused-scan lookahead (docs/FUSED.md): the engine
+                # loop is the only place that knows the callback set
+                # and end_iteration, so it bounds how far one scan
+                # window may run ahead of the per-iteration cadence.
+                # valid_sets=[train_set] keeps engine.valid_sets empty
+                # (scan stays eligible) but this loop then evaluates
+                # the TRAIN score inline every metric_freq iterations
+                # — windows must end on that cadence too.
+                booster._engine._scan_horizon = _scan_lookahead(
+                    callbacks, i, end_iteration,
+                    engine_iteration=int(booster._engine.iter_),
+                    eval_every=(max(1, cfg.metric_freq)
+                                if is_valid_contain_train else None))
             for cb in cbs_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
@@ -226,6 +283,13 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
         if booster._engine is not None:
             booster._engine.finish_faults()
     finally:
+        if booster._engine is not None:
+            # restore the documented direct-API behavior: only this
+            # loop may grant lookahead, so a booster returned with a
+            # stale multi-iteration horizon (break on stall / early
+            # stop / an exception) must not dispatch windows from
+            # plain update() calls
+            booster._engine._scan_horizon = 1
         _finish_callbacks(callbacks)
 
     if booster.best_iteration <= 0:

@@ -19,7 +19,8 @@ rf.hpp, bagging.hpp and goss.hpp for TPU:
 from __future__ import annotations
 
 import functools
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, NamedTuple, Optional, Sequence, \
+    Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,14 +31,47 @@ from ..obs import register_jit
 from ..objectives import Objective
 from ..resilience.faults import FaultPlan, is_resource_exhausted
 from ..ops.gather import gather_small
-from ..ops.grow import GrowConfig, TreeArrays, grow_tree
+from ..ops.grow import GrowConfig, TreeArrays, grow_tree, grow_tree_impl
 from ..ops.predict import predict_leaf_binned
 from ..ops.renew import renew_leaf_values
 from ..ops.split import SplitParams
 from .tree import (Tree, pack_tree_device, tree_from_arrays,
                    unpack_tree_host)
 
-__all__ = ["GBDTBooster", "resolve_hist_method"]
+__all__ = ["GBDTBooster", "resolve_hist_method", "resolve_scan_iters"]
+
+
+def resolve_scan_iters(requested) -> int:
+    """Concrete scan-window budget from ``Config.fused_scan_iters``.
+
+    Returns the max number of boosting iterations one fused
+    ``lax.scan`` program may cover (1 = stay on the per-iteration
+    fused path). Like the pallas flip (``resolve_hist_method``),
+    ``auto`` stays at 1 until the Higgs-shaped
+    ``benchmarks/fused_iter_bench.py`` scan arm measures an iters/sec
+    win on chip — ``LIGHTGBM_TPU_AUTO_SCAN_ITERS=N`` opts auto in for
+    that measurement, and ``LIGHTGBM_TPU_DISABLE_SCAN=1`` is the kill
+    switch that pins everything (including explicit integers) back to
+    per-iteration dispatch."""
+    import os
+
+    if os.environ.get("LIGHTGBM_TPU_DISABLE_SCAN") == "1":
+        return 1
+    if requested == "auto":
+        env = os.environ.get("LIGHTGBM_TPU_AUTO_SCAN_ITERS", "")
+        if env:
+            try:
+                # same [1, 1024] ceiling Config validation enforces
+                # for an explicit fused_scan_iters (a 100k-slot scan
+                # only grows trace time)
+                return min(1024, max(1, int(env)))
+            except ValueError:
+                from ..utils.log import log_warning
+                log_warning(
+                    f"LIGHTGBM_TPU_AUTO_SCAN_ITERS={env!r} is not an "
+                    "integer; keeping the per-iteration fused path")
+        return 1
+    return max(1, int(requested))
 
 
 def resolve_hist_method(requested: str, backend: Optional[str] = None,
@@ -108,6 +142,99 @@ def _gh_flag_clamp(g, h, policy):
         g = _nf_clamp(g, -_NF_CLAMP, _NF_CLAMP)
         h = _nf_clamp(h, 0.0, _NF_CLAMP)
     return g, h, flag
+
+
+def _leaf_value_guard(dev_tree, gh_flag, policy):
+    """Fitted-leaf-value guard (pure jnp, shared verbatim by the eager
+    path, the fused step and the scan body): extend the iteration flag
+    with the leaf bit and apply the policy on device — clamp rewrites
+    the leaf table, skip_tree demotes the tree to a no-op constant
+    (the AsConstantTree path downstream)."""
+    lv = dev_tree.leaf_value
+    flag = gh_flag | jnp.where(jnp.all(jnp.isfinite(lv)), 0,
+                               _NF_LEAF).astype(jnp.int32)
+    if policy == "clamp":
+        dev_tree = dev_tree._replace(
+            leaf_value=_nf_clamp(lv, -_NF_CLAMP, _NF_CLAMP))
+    elif policy == "skip_tree":
+        ok = flag == 0
+        dev_tree = dev_tree._replace(
+            num_leaves=jnp.where(ok, dev_tree.num_leaves, 1),
+            leaf_value=jnp.where(ok, lv, jnp.zeros_like(lv)))
+    return dev_tree, flag
+
+
+class _StepCtx(NamedTuple):
+    """Static context of one fused boosting iteration — everything
+    :func:`_fused_iter_step` needs beyond its traced operands. Built
+    once per engine state (``GBDTBooster._step_ctx``) and closed over
+    by BOTH the per-iteration jitted step and the multi-iteration scan
+    body, so the two programs trace the identical ops by
+    construction."""
+    gcfg: GrowConfig
+    K: int
+    obj: object
+    nf_policy: str
+    quant: bool
+    bynode: bool
+    base_key: object
+    bynode_key: object
+    inj_grad: object      # fault-injection iteration arrays (or None):
+    inj_hess: object      # traced as where(it == N) — zero recompiles
+
+
+def _fused_iter_step(ctx: _StepCtx, score, it, shrink, row_w, fmask,
+                     bins_T, fnb, fnan, label, weight, monotone,
+                     feat_is_cat, igroups, forced, bundle):
+    """One boosting iteration as pure traced ops: gradients -> guard ->
+    K tree grows -> pack -> contrib -> score update. Returns
+    ``(new_score, [(vec, cmask, num_leaves)] * K, flags[K])``. The
+    per-iteration fused program jits a thin wrapper over this
+    (``_get_fused_fn.step``) and the multi-iteration scan
+    (``_get_scan_fn``) calls it per window slot — one implementation,
+    every fused path."""
+    obj, K = ctx.obj, ctx.K
+    g, h = obj.grad_hess(score if K > 1 else score[0], label, weight)
+    if K == 1:
+        g, h = g[None, :], h[None, :]
+    if ctx.inj_grad is not None:
+        g = jnp.where(jnp.any(it == ctx.inj_grad),
+                      jnp.float32(jnp.nan), g)
+    if ctx.inj_hess is not None:
+        h = jnp.where(jnp.any(it == ctx.inj_hess),
+                      jnp.float32(jnp.nan), h)
+    # non-finite guard, fused into this one program via the same
+    # pure-jnp helper the eager path uses: the isfinite reductions cost
+    # a single pass; the resulting flag rides back with the tree
+    # outputs and is checked one iteration late on the host (no
+    # per-iteration device sync)
+    g, h, gh_flag = _gh_flag_clamp(g, h, ctx.nf_policy)
+    # identical key schedule to the eager path (fold_in is a pure
+    # device op, so tracing it keeps streams bit-equal)
+    qk_it = jax.random.fold_in(ctx.base_key, it) if ctx.quant else None
+    nk_it = jax.random.fold_in(ctx.bynode_key, it) if ctx.bynode \
+        else None
+    new_score = score
+    outs = []
+    flags = []
+    for k in range(K):
+        qk = jax.random.fold_in(qk_it, k) if ctx.quant else None
+        nk = jax.random.fold_in(nk_it, k) if ctx.bynode else None
+        dev_tree, row_leaf = grow_tree_impl(
+            ctx.gcfg, bins_T, g[k], h[k], row_w, fmask, fnb, fnan,
+            monotone, feat_is_cat, qk, igroups, forced, None, nk,
+            bundle)
+        dev_tree, flag_k = _leaf_value_guard(dev_tree, gh_flag,
+                                             ctx.nf_policy)
+        vec, cmask = pack_tree_device(dev_tree)
+        contrib = gather_small(dev_tree.leaf_value, row_leaf)
+        # a no-growth tree is replaced by a constant at flush
+        # (AsConstantTree): contribute nothing now
+        contrib = jnp.where(dev_tree.num_leaves > 1, contrib, 0.0)
+        new_score = new_score.at[k].add(contrib * shrink)
+        outs.append((vec, cmask, dev_tree.num_leaves))
+        flags.append(flag_k)
+    return new_score, outs, jnp.stack(flags)
 
 
 @jax.jit
@@ -385,6 +512,16 @@ class GBDTBooster:
         self._fused_proto = None
         self._row_w_ones = None
         self._fmask_cached = None
+        # multi-iteration scan state (docs/FUSED.md): compiled window
+        # programs by (W, bag_live), the pending precomputed window,
+        # the last committed iteration's window position (telemetry),
+        # and the engine-driven lookahead horizon — 1 (scan off) until
+        # the train() loop proves how far ahead the window may run
+        # without a callback observing mid-window state
+        self._scan_fns: Dict[tuple, Callable] = {}
+        self._scan_pend: Optional[dict] = None
+        self._scan_last: Optional[dict] = None
+        self._scan_horizon = 1
 
         # only ONE training matrix ever reaches HBM: bundled when EFB
         # engaged, the plain [F, n] matrix otherwise. Materialization
@@ -629,6 +766,11 @@ class GBDTBooster:
         self._guard_async = []
         self._fault_recent = False
         self._finished_natural = False
+        # precomputed scan lookahead belongs to the replaced model;
+        # callers (preload_models / checkpoint restore) install the
+        # matching score right after, so no rebuild here
+        self._scan_pend = None
+        self._scan_last = None
         self._models_store = list(v)
 
     # ------------------------------------------------------------------
@@ -658,22 +800,10 @@ class GBDTBooster:
         return _gh_flag_clamp(grad, hess, self._nf_policy)
 
     def _leaf_guard(self, dev_tree, gh_flag):
-        """Fitted-leaf-value guard: extend the iteration flag and apply
-        the policy on device — clamp rewrites the leaf table,
-        skip_tree demotes the tree to a no-op constant (the
-        AsConstantTree path downstream)."""
-        lv = dev_tree.leaf_value
-        flag = gh_flag | jnp.where(jnp.all(jnp.isfinite(lv)), 0,
-                                   _NF_LEAF).astype(jnp.int32)
-        if self._nf_policy == "clamp":
-            dev_tree = dev_tree._replace(
-                leaf_value=_nf_clamp(lv, -_NF_CLAMP, _NF_CLAMP))
-        elif self._nf_policy == "skip_tree":
-            ok = flag == 0
-            dev_tree = dev_tree._replace(
-                num_leaves=jnp.where(ok, dev_tree.num_leaves, 1),
-                leaf_value=jnp.where(ok, lv, jnp.zeros_like(lv)))
-        return dev_tree, flag
+        """Fitted-leaf-value guard — delegates to the module-level
+        pure-jnp :func:`_leaf_value_guard` so the eager path, the fused
+        step and the scan body apply the one implementation."""
+        return _leaf_value_guard(dev_tree, gh_flag, self._nf_policy)
 
     # tpulint: hot
     def _push_guard_flags(self, it: int, flags) -> None:
@@ -775,6 +905,7 @@ class GBDTBooster:
         # drop every cached program that baked the old grow_cfg in
         self._fused_fn = None
         self._fused_proto = None
+        self._scan_fns = {}
         if self.mesh is not None and self._grow_fn is not None:
             self._grow_fn = self._build_grow_fn()
         detail = f"RESOURCE_EXHAUSTED in {what}; retrying after downgrade"
@@ -790,6 +921,11 @@ class GBDTBooster:
                 self._score_dataset_binned(self.train_set))
             detail += "; score buffer was donated to the failed " \
                       "dispatch — rebuilt from trees"
+        # the scan program donates the bagging carry too: a consumed
+        # cache is dropped and re-drawn at the next refresh check
+        if self._cached_bag is not None and getattr(
+                self._cached_bag, "is_deleted", lambda: False)():
+            self._cached_bag = None
         self._record_fault("oom", self.iter_, action, detail)
         return True
 
@@ -1274,6 +1410,18 @@ class GBDTBooster:
 
     _cached_bag: Optional[jnp.ndarray] = None
 
+    def _bag_live(self) -> bool:
+        """Live bagging gate, re-read from cfg on every call
+        (reset_parameter may toggle bagging mid-training): the ONE
+        definition of ``_row_weights``' bagging branch condition,
+        shared by the fused driver, the scan dispatch and the scan
+        abort so the gates can never drift apart."""
+        cfg = self.cfg
+        return cfg.bagging_freq > 0 and (
+            cfg.bagging_fraction < 1.0
+            or cfg.pos_bagging_fraction < 1.0
+            or cfg.neg_bagging_fraction < 1.0)
+
     def _feature_mask(self) -> jnp.ndarray:
         """Per-tree column sampling (ColSampler::ResetByTree analog)."""
         cfg = self.cfg
@@ -1321,19 +1469,11 @@ class GBDTBooster:
                 # at trace time and then freeze
                 and not getattr(self.objective, "is_ranking", False))
 
-    def _get_fused_fn(self):
-        if self._fused_fn is not None:
-            return self._fused_fn
-        from ..ops.grow import grow_tree_impl
-
+    def _step_ctx(self) -> _StepCtx:
+        """The static per-iteration context both fused programs close
+        over (see :class:`_StepCtx`). Rebuilt per program build so an
+        OOM downgrade's new ``grow_cfg`` is picked up."""
         gcfg = self.grow_cfg
-        K = self.K
-        obj = self.objective
-        quant = gcfg.quantized and gcfg.stochastic
-        bynode = gcfg.bynode < 1.0
-        base_key = self._base_key
-        bynode_key = self._bynode_key
-        nf_policy = self._nf_policy
         # fault injection (test harness): the schedule is static per
         # engine, so the poisoning folds into the traced program as a
         # where(it == N) — zero recompiles, exact device-side replay
@@ -1343,10 +1483,23 @@ class GBDTBooster:
         inj_hess = jnp.asarray(self._fault_plan.iters("nan_hess"),
                                jnp.int32) \
             if self._fault_plan.iters("nan_hess") else None
+        return _StepCtx(
+            gcfg=gcfg, K=self.K, obj=self.objective,
+            nf_policy=self._nf_policy,
+            quant=gcfg.quantized and gcfg.stochastic,
+            bynode=gcfg.bynode < 1.0,
+            base_key=self._base_key, bynode_key=self._bynode_key,
+            inj_grad=inj_grad, inj_hess=inj_hess)
 
-        # the pending-tree proto (ShapeDtypeStructs for unpack at
-        # flush) is config-static: derive it once by abstract eval
-        # instead of returning the whole dev_tree pytree every call
+    def _fused_tree_proto(self):
+        """The pending-tree proto (ShapeDtypeStructs for unpack at
+        flush) is config-static: derive it once by abstract eval
+        instead of returning the whole dev_tree pytree every call."""
+        if self._fused_proto is not None:
+            return self._fused_proto
+        gcfg = self.grow_cfg
+        quant = gcfg.quantized and gcfg.stochastic
+        bynode = gcfg.bynode < 1.0
         sds = jax.ShapeDtypeStruct((self.n,), jnp.float32)
         key_sds = jax.ShapeDtypeStruct(self._base_key.shape,
                                        self._base_key.dtype)
@@ -1362,53 +1515,23 @@ class GBDTBooster:
             self.interaction_groups, self.forced, None,
             key_sds if bynode else None, self._bundle_dev)
         self._fused_proto = proto
+        return proto
+
+    def _get_fused_fn(self):
+        if self._fused_fn is not None:
+            return self._fused_fn
+        self._fused_tree_proto()
+        ctx = self._step_ctx()
 
         def step(score, it, shrink, row_w, fmask, bins_T, fnb, fnan,
                  label, weight, monotone, feat_is_cat, igroups, forced,
                  bundle):
-            g, h = obj.grad_hess(score if K > 1 else score[0], label,
-                                 weight)
-            if K == 1:
-                g, h = g[None, :], h[None, :]
-            if inj_grad is not None:
-                g = jnp.where(jnp.any(it == inj_grad),
-                              jnp.float32(jnp.nan), g)
-            if inj_hess is not None:
-                h = jnp.where(jnp.any(it == inj_hess),
-                              jnp.float32(jnp.nan), h)
-            # non-finite guard, fused into this one program via the
-            # same pure-jnp helper the eager path uses: the isfinite
-            # reductions cost a single pass; the resulting flag rides
-            # back with the tree outputs and is checked one iteration
-            # late on the host (no per-iteration device sync)
-            g, h, gh_flag = _gh_flag_clamp(g, h, nf_policy)
-            # identical key schedule to the eager path (fold_in is a
-            # pure device op, so tracing it keeps streams bit-equal)
-            qk_it = jax.random.fold_in(base_key, it) if quant else None
-            nk_it = jax.random.fold_in(bynode_key, it) if bynode else None
-            new_score = score
-            outs = []
-            flags = []
-            for k in range(K):
-                qk = jax.random.fold_in(qk_it, k) if quant else None
-                nk = jax.random.fold_in(nk_it, k) if bynode else None
-                dev_tree, row_leaf = grow_tree_impl(
-                    gcfg, bins_T, g[k], h[k], row_w, fmask, fnb, fnan,
-                    monotone, feat_is_cat, qk, igroups, forced, None,
-                    nk, bundle)
-                # _leaf_guard is pure jnp, so the eager helper traces
-                # here verbatim — one implementation, both paths
-                dev_tree, flag_k = self._leaf_guard(dev_tree, gh_flag)
-                vec, cmask = pack_tree_device(dev_tree)
-                contrib = gather_small(dev_tree.leaf_value, row_leaf)
-                # a no-growth tree is replaced by a constant at flush
-                # (AsConstantTree): contribute nothing now
-                contrib = jnp.where(dev_tree.num_leaves > 1, contrib,
-                                    0.0)
-                new_score = new_score.at[k].add(contrib * shrink)
-                outs.append((vec, cmask, dev_tree.num_leaves))
-                flags.append(flag_k)
-            return new_score, outs, jnp.stack(flags)
+            # the whole iteration body lives in the module-level
+            # _fused_iter_step — the scan path traces the same ops
+            return _fused_iter_step(ctx, score, it, shrink, row_w,
+                                    fmask, bins_T, fnb, fnan, label,
+                                    weight, monotone, feat_is_cat,
+                                    igroups, forced, bundle)
 
         # donate the old score buffer (it is consumed) — except on CPU,
         # where XLA ignores donation and warns
@@ -1416,6 +1539,288 @@ class GBDTBooster:
         self._fused_fn = register_jit("gbdt/fused_iter",
                                       jax.jit(step, donate_argnums=donate))
         return self._fused_fn
+
+    # ------------------------------------------------------------------
+    # multi-iteration fused scan: a whole window of boosting iterations
+    # as ONE lax.scan program with donated carries (docs/FUSED.md)
+    # ------------------------------------------------------------------
+    def _scan_ok(self) -> bool:
+        """Refinement of ``_fused_ok``: configs whose per-iteration
+        host work the scan body can carry on device. Host-RNG
+        consumers (``feature_fraction`` draws a np.RandomState mask per
+        tree) and mid-window host injections (``oom@N``) fall back to
+        the per-iteration fused path; bagging (device fold_in keys),
+        pos/neg bagging, bynode sampling, quantized training and every
+        grower ride the carry."""
+        cfg = self.cfg
+        return (cfg.feature_fraction >= 1.0
+                and cfg.boosting == "gbdt"
+                and not self._fault_plan.iters("oom"))
+
+    def _scan_window(self) -> int:
+        """Iterations the next dispatch may cover: the configured
+        budget, clamped to the engine-provided lookahead horizon (the
+        distance to the next point an outside consumer — checkpoint
+        cadence, end of training, an unknown callback — reads
+        per-iteration state the window would skate past)."""
+        budget = resolve_scan_iters(self.cfg.fused_scan_iters)
+        if budget <= 1 or not self._scan_ok():
+            return 1
+        return max(1, min(budget, self._scan_horizon))
+
+    def _make_bag_refresh(self):
+        """Traced twin of ``_row_weights``' bagging branch: draw the
+        in-bag weight vector for iteration ``it`` from the identical
+        fold_in key schedule, so carry-resident bagging is bit-equal
+        to the host-side draws of the eager/fused paths."""
+        cfg = self.cfg
+        n = self.n
+        seed_key = jax.random.PRNGKey(cfg.bagging_seed)
+        pos, neg = cfg.pos_bagging_fraction, cfg.neg_bagging_fraction
+        frac = cfg.bagging_fraction
+        posneg = pos < 1.0 or neg < 1.0
+
+        def fresh(it, label):
+            key = jax.random.fold_in(seed_key, it)
+            u = jax.random.uniform(key, (n,))
+            if posneg:
+                is_pos = label > 0
+                fr = jnp.where(is_pos, pos, neg)
+                return (u < fr).astype(jnp.float32)
+            return (u < frac).astype(jnp.float32)
+
+        return fresh
+
+    def _get_scan_fn(self, W: int, bag_live: bool):
+        """Build (and cache) the W-iteration scan program: carries are
+        the donated score matrix, the bagging weight vector and the
+        natural-stop flag; the stacked per-iteration tree packs, leaf
+        counts and guard flags come back as the scan's ys — one
+        N-slot output buffer fetched per window, not per iteration."""
+        key = (W, bag_live)
+        fn = self._scan_fns.get(key)
+        if fn is not None:
+            return fn
+        self._fused_tree_proto()
+        ctx = self._step_ctx()
+        freq = max(1, self.cfg.bagging_freq)
+        fresh_bag = self._make_bag_refresh() if bag_live else None
+        from jax import lax
+
+        def scan_fn(score, bag, it0, shrink, fmask, bins_T, fnb, fnan,
+                    label, weight, monotone, feat_is_cat, igroups,
+                    forced, bundle):
+            def body(carry, it):
+                score, bag, stop = carry
+                if bag_live:
+                    # refresh cadence traced from the absolute
+                    # iteration — identical to _row_weights' host
+                    # check; a stopped window never consumes draws
+                    refresh = jnp.logical_and(it % freq == 0,
+                                              jnp.logical_not(stop))
+                    bag = lax.cond(refresh,
+                                   lambda b: fresh_bag(it, label),
+                                   lambda b: b, bag)
+                new_score, outs, flags = _fused_iter_step(
+                    ctx, score, it, shrink, bag, fmask, bins_T, fnb,
+                    fnan, label, weight, monotone, feat_is_cat,
+                    igroups, forced, bundle)
+                vecs = jnp.stack([o[0] for o in outs])
+                cmasks = jnp.stack([o[1] for o in outs])
+                nls = jnp.stack([o[2] for o in outs])
+                # natural-stop gating: once an iteration grows nothing
+                # (and no fault demoted it — skip_tree leaves look
+                # identical), later slots become score no-ops, exactly
+                # where the per-iteration driver would have stopped;
+                # the host drain discards their emitted trees
+                new_score = jnp.where(stop, score, new_score)
+                stalled = jnp.logical_and(jnp.all(nls <= 1),
+                                          jnp.all(flags == 0))
+                return ((new_score, bag, jnp.logical_or(stop, stalled)),
+                        (vecs, cmasks, nls, flags))
+
+            its = it0 + jnp.arange(W, dtype=jnp.int32)
+            carry0 = (score, bag, jnp.asarray(False))
+            (score, bag, _), ys = lax.scan(body, carry0, its)
+            return (score, bag) + ys
+
+        # donate the score AND bagging carries (both are consumed) —
+        # except on CPU, where XLA ignores donation and warns
+        donate = () if jax.default_backend() == "cpu" else (0, 1)
+        fn = register_jit("gbdt/fused_scan",
+                          jax.jit(scan_fn, donate_argnums=donate))
+        self._scan_fns[key] = fn
+        return fn
+
+    # tpulint: hot
+    def _dispatch_scan_window(self, W: int) -> bool:
+        """Run the next ``W`` boosting iterations as one scan program
+        and queue the results; pops hand them to the driver one
+        iteration at a time so callbacks/telemetry keep their
+        per-iteration cadence. The batched ``jax.device_get`` below is
+        the scan pipeline's ONE window-boundary sync point (tpulint
+        TPL002 baseline): every per-iteration fetch, dispatch and
+        driver pass between window edges is gone."""
+        from ..utils.timer import timed
+
+        cfg = self.cfg
+        it0 = self.iter_
+        bag_live = self._bag_live()
+        with timed("boosting/bagging"):
+            if bag_live:
+                freq = max(1, cfg.bagging_freq)
+                if it0 % freq == 0:
+                    # refresh-aligned entry: the body's first slot
+                    # redraws the carry unconditionally, so the host
+                    # draw would be discarded — donate a placeholder
+                    # instead of a wasted [n] uniform pass
+                    bag_key_it = None
+                    bag0 = jnp.zeros((self.n,), jnp.float32)
+                else:
+                    # the WINDOW-ENTRY bag follows the eager rule at
+                    # it0 (reuse the cache, else draw fresh at it0).
+                    # Remember which iteration it was KEYED at — a
+                    # sequential cache always came from the last
+                    # refresh (checkpoint restore re-derives it there
+                    # too) — so the OOM-retry path below can reproduce
+                    # the exact draw after a failed dispatch consumed
+                    # (donated) it.
+                    bag_key_it = (it0 // freq) * freq \
+                        if self._cached_bag is not None else it0
+                    bag0 = self._row_weights(it0, None, None)
+            else:
+                # a fresh ones buffer per window: the carry is donated,
+                # so the shared _row_w_ones must not be consumed
+                bag0 = jnp.ones((self.n,), jnp.float32)
+            if self._fmask_cached is None:
+                self._fmask_cached = self._feature_mask()
+            fmask = self._fmask_cached
+        with timed("boosting/fused_scan"):
+            def dispatch():
+                # re-reads _get_scan_fn so an OOM downgrade's rebuilt
+                # program is picked up on the retry — and re-derives
+                # the bagging carry if the failed dispatch already
+                # consumed (donated) it: re-drawn at the iteration the
+                # entry bag was KEYED at (not it0 — a cache-served
+                # entry bag came from the last refresh iteration, and
+                # _row_weights(it0) on the now-empty cache would draw
+                # a fresh vector no other path ever uses)
+                nonlocal bag0
+                if getattr(bag0, "is_deleted", lambda: False)():
+                    if not bag_live:
+                        bag0 = jnp.ones((self.n,), jnp.float32)
+                    elif bag_key_it is None:
+                        # refresh-aligned placeholder (overwritten by
+                        # the body's first slot)
+                        bag0 = jnp.zeros((self.n,), jnp.float32)
+                    else:
+                        self._cached_bag = None
+                        bag0 = self._row_weights(bag_key_it, None,
+                                                 None)
+                return self._get_scan_fn(W, bag_live)(
+                    self.score, bag0, jnp.asarray(it0, jnp.int32),
+                    jnp.asarray(self._shrinkage, jnp.float32), fmask,
+                    self.bins_T, self.feat_num_bins, self.feat_nan_bin,
+                    self.label, self.weight, self.monotone,
+                    self.feat_is_cat, self.interaction_groups,
+                    self.forced, self._bundle_dev)
+
+            out = self._run_with_oom_degrade(dispatch,
+                                             "fused scan window")
+            new_score, new_bag, vecs, cmasks, nls, flags = out
+            # the ONE legal sync of the window: the whole window's tree
+            # packs, leaf counts and guard flags cross device->host as
+            # a single batched fetch (docs/FUSED.md)
+            vecs_h, cmasks_h, nls_h, flags_h = jax.device_get(
+                (vecs, cmasks, nls, flags))
+        self.score = new_score
+        if bag_live:
+            self._cached_bag = new_bag
+        # the dispatch-time shrinkage is stamped into the pend: the
+        # traced window already scored contrib * THIS value, so pops
+        # must flush trees with it even if _shrinkage moves later
+        # (a learning_rate reset additionally aborts the pend —
+        # basic.py reset_parameter — so the new rate takes effect at
+        # the very next iteration like the per-iteration path)
+        self._scan_pend = {"it0": it0, "W": W, "pos": 0,
+                           "shrink": self._shrinkage,
+                           "vec": vecs_h, "cmask": cmasks_h,
+                           "nl": nls_h, "flags": flags_h}
+        from ..obs.registry import registry as _registry
+        _registry.counter("fused_scan_windows").inc()
+        return self._pop_scan_iter()
+
+    # tpulint: hot
+    def _pop_scan_iter(self) -> bool:
+        """Commit ONE precomputed window iteration to the driver state:
+        defer its K trees (host numpy slices of the batched pack — no
+        device traffic), queue its guard flags for the one-late drain,
+        and advance the iteration counter. The no-growth / fault-raise
+        decisions stay in ``train_one_iter``'s existing host logic,
+        which sees exactly the per-iteration stream it always saw."""
+        p = self._scan_pend
+        j = p["pos"]
+        it = p["it0"] + j
+        self._push_guard_flags(it, p["flags"][j])
+        fold_now = it == 0 and self._fold_bias
+        for k in range(self.K):
+            bias = float(self.init_score[k]) if fold_now else 0.0
+            self._defer_tree(p["vec"][j, k], p["cmask"][j, k],
+                             self._fused_proto, p["nl"][j, k],
+                             p["shrink"], bias)
+        p["pos"] += 1
+        self._scan_last = {"window": int(p["W"]), "pos": int(j),
+                           "dispatch": j == 0}
+        if p["pos"] >= p["W"]:
+            self._scan_pend = None
+        self.iter_ += 1
+        return False
+
+    def _abort_scan_window(self,
+                           next_iter: Optional[int] = None) -> None:
+        """Discard precomputed lookahead iterations (rollback, model
+        replacement, a custom-gradient update arriving mid-window).
+        The window's final score includes the discarded slots, so the
+        score is rebuilt from the materialized trees — last-ulp
+        different from incremental accumulation, the same forfeit as
+        the OOM donation rebuild.
+
+        ``next_iter``: the iteration that will train next —
+        ``iter_`` by default, but ``rollback_one_iter`` passes
+        ``iter_ - 1`` because it decrements AFTER this abort (an
+        on-cadence ``iter_`` would otherwise skip the cache
+        re-derivation that the post-rollback off-cadence iteration
+        needs)."""
+        if self._scan_pend is None:
+            return
+        self._scan_pend = None
+        self._scan_last = None
+        self.score = self._place_score(
+            self._score_dataset_binned(self.train_set))
+        # the carry-resident bag ran ahead with the window; re-derive
+        # the cache at the LAST REFRESH iteration so the next
+        # _row_weights reuses the same draw the per-iteration path
+        # would (checkpoint restore does the identical re-derivation;
+        # drawing fresh at an off-cadence iteration would silently
+        # fork the bagging stream)
+        next_iter = self.iter_ if next_iter is None \
+            else max(0, next_iter)
+        self._cached_bag = None
+        if self._bag_live():
+            freq = self.cfg.bagging_freq
+            last_refresh = (next_iter // freq) * freq
+            if last_refresh < next_iter:
+                self._row_weights(last_refresh, None, None)
+
+    def telemetry_scan_stats(self) -> Optional[Dict[str, object]]:
+        """Scan-window position of the LAST committed iteration for
+        the telemetry recorder (obs/recorder.py): ``window`` size,
+        ``pos`` inside it, and whether this iteration carried the
+        window dispatch (its event absorbs the whole window's device
+        phase time). None when the iteration ran per-iteration."""
+        if self._scan_last is None:
+            return None
+        return dict(self._scan_last)
 
     # tpulint: hot
     def _train_one_iter_fused(self) -> bool:
@@ -1425,8 +1830,19 @@ class GBDTBooster:
         bagging weights) stay OUTSIDE the program and feed it as
         arguments so their streams match the eager path exactly; the
         finished tree comes back the same deferred route
-        (_pending_dev + async copies) the eager defer branch uses."""
+        (_pending_dev + async copies) the eager defer branch uses.
+
+        When a multi-iteration scan window is active (or can start —
+        Config.fused_scan_iters, docs/FUSED.md), the iteration is
+        popped from / dispatched as one whole-window program
+        instead."""
         from ..utils.timer import timed
+
+        if self._scan_pend is not None:
+            return self._pop_scan_iter()
+        W = self._scan_window()
+        if W > 1:
+            return self._dispatch_scan_window(W)
 
         cfg = self.cfg
         it = self.iter_
@@ -1435,10 +1851,7 @@ class GBDTBooster:
             # _bag_active snapshot): reset_parameter may turn bagging
             # on/off mid-training (LGBM_BoosterResetParameter), and the
             # eager path's _row_weights re-reads cfg every iteration
-            bag_live = cfg.bagging_freq > 0 and (
-                cfg.bagging_fraction < 1.0
-                or cfg.pos_bagging_fraction < 1.0
-                or cfg.neg_bagging_fraction < 1.0)
+            bag_live = self._bag_live()
             if bag_live:
                 row_w = self._row_weights(it, None, None)
             else:
@@ -1500,6 +1913,18 @@ class GBDTBooster:
         cfg = self.cfg
         it = self.iter_
 
+        # scan-window bookkeeping: the telemetry marker tracks only the
+        # path actually taken this iteration, and precomputed lookahead
+        # survives ONLY while _pop_scan_iter will serve this iteration
+        # — a custom-gradient update, or a _fused_ok flip mid-pend
+        # (add_valid between direct update() calls), would otherwise
+        # train eagerly from the window-ahead score with stale packs
+        # still queued
+        self._scan_last = None
+        if self._scan_pend is not None and (custom_grad is not None
+                                            or not self._fused_ok()):
+            self._abort_scan_window()
+
         # non-finite guard flags from the previous (async) program,
         # checked one iteration late like the tree queue below —
         # raises/records per nonfinite_policy (resilience/)
@@ -1534,6 +1959,11 @@ class GBDTBooster:
                 # remembered past the drain: a checkpoint written after
                 # this point must still carry the stalled marker
                 self._finished_natural = True
+                # lookahead iterations a scan window precomputed past
+                # the natural stop never happened: the scan body's stop
+                # carry already froze the score at this point, so the
+                # queued packs are simply dropped
+                self._scan_pend = None
                 return True
 
         # Fast path: the whole iteration (gradients -> grow -> tree pack
@@ -1880,6 +2310,10 @@ class GBDTBooster:
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
         """RollbackOneIter (gbdt.cpp:454)."""
+        # a pending scan window's score runs ahead of iter_; restore
+        # the committed-state score before unwinding one iteration
+        # (next_iter: the decrement below happens after this abort)
+        self._abort_scan_window(next_iter=self.iter_ - 1)
         self._nl_async = []
         self._guard_async = []
         self._fault_recent = False

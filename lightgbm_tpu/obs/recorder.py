@@ -38,9 +38,15 @@ __all__ = ["TelemetryRecorder", "ITERATION_EVENT_KEYS",
 #: ``comm`` is the collective-payload record of distributed training
 #: (payload bytes from the dtype-aware model in parallel/comms.py,
 #: the hist_comm wire mode, and the parallelism mode chosen) — null
-#: on single-device runs, which move no bytes.
+#: on single-device runs, which move no bytes. ``scan`` is the fused
+#: scan-window position of the iteration (models/gbdt.py
+#: ``fused_scan_iters``, docs/FUSED.md): ``{"window": W, "pos": j,
+#: "dispatch": bool}`` — the dispatch event absorbs the whole window's
+#: device phase time, the other W-1 events in the window are
+#: host-side pops — or null on per-iteration paths.
 ITERATION_EVENT_KEYS = ("event", "iteration", "wall_time", "phases",
-                        "recompiles", "hbm", "tree", "eval", "comm")
+                        "recompiles", "hbm", "tree", "eval", "comm",
+                        "scan")
 
 
 class TelemetryRecorder:
@@ -213,6 +219,19 @@ class TelemetryRecorder:
                 return stats
         return None
 
+    def _scan_stats(self) -> Optional[Dict[str, object]]:
+        """The iteration's fused scan-window position from the first
+        engine that committed one (models/gbdt.py
+        telemetry_scan_stats); None on per-iteration paths."""
+        for eng in self._engines:
+            getter = getattr(eng, "telemetry_scan_stats", None)
+            if getter is None:
+                continue
+            stats = getter()
+            if stats is not None:
+                return stats
+        return None
+
     @staticmethod
     def _eval_dict(evals: Optional[Sequence]) -> Dict[str, float]:
         out: Dict[str, float] = {}
@@ -302,6 +321,7 @@ class TelemetryRecorder:
             "tree": tree,
             "eval": self._eval_dict(evals),
             "comm": self._comm_stats(tree),
+            "scan": self._scan_stats(),
         }
         self._feed_registry(event)
         self._drain_fault_events()  # fault lines precede their iteration
@@ -329,6 +349,9 @@ class TelemetryRecorder:
                         mode=str(comm["parallel_mode"]),
                         wire=str(comm["hist_comm"])).inc(
                 comm["payload_bytes"])
+        scan = event.get("scan")
+        if scan:
+            reg.counter("fused_scan_iterations").inc()
 
 
 # ---------------------------------------------------------------------
@@ -378,6 +401,8 @@ def summarize_events(path: str) -> dict:
     comm_bytes = 0
     comm_post_bytes = 0
     comm_last: Optional[Dict[str, object]] = None
+    scan_windows = 0
+    scan_iterations = 0
 
     def _parse(line: str, is_last: bool) -> Optional[dict]:
         try:
@@ -447,6 +472,10 @@ def summarize_events(path: str) -> dict:
             comm_post_bytes += int(ev["comm"].get(
                 "post_reduction_bytes",
                 ev["comm"].get("payload_bytes", 0)))
+        if ev.get("scan"):
+            scan_iterations += 1
+            if ev["scan"].get("dispatch"):
+                scan_windows += 1
     return {"iterations": iters, "wall_time": wall, "phases": phases,
             "recompiles": recompiles, "peak_hbm_bytes": peak_hbm,
             "total_leaves": leaves, "total_split_gain": gain,
@@ -454,7 +483,9 @@ def summarize_events(path: str) -> dict:
             "serve": serve, "serve_events": serve_events,
             "comm_bytes": comm_bytes,
             "comm_post_reduction_bytes": comm_post_bytes,
-            "comm": comm_last}
+            "comm": comm_last,
+            "scan_windows": scan_windows,
+            "scan_iterations": scan_iterations}
 
 
 def render_stats_table(summary: dict) -> str:
@@ -499,6 +530,12 @@ def render_stats_table(summary: dict) -> str:
             f"{comm.get('split_search', 'gathered')} search, world "
             f"{comm.get('world', '?')}; post-reduction "
             f"{pb / 2**20:.1f} MiB)")
+    if summary.get("scan_windows"):
+        lines.append(
+            f"fused scan           : {summary['scan_iterations']} "
+            f"iterations in {summary['scan_windows']} window(s) "
+            f"(~{summary['scan_iterations'] / summary['scan_windows']:.1f}"
+            " iters/dispatch)")
     lines.append(f"leaves grown         : {summary['total_leaves']}")
     lines.append(f"split gain sum       : {summary['total_split_gain']:g}")
     faults = summary.get("faults") or {}

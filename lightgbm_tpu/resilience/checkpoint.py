@@ -207,7 +207,12 @@ def _dataset_fingerprint(eng) -> Dict[str, Any]:
 #: drift.
 _FINGERPRINT_IGNORE = {"num_iterations", "input_model", "output_model",
                        "snapshot_freq", "data", "valid", "output_result",
-                       "shard_residency", "split_search"}
+                       "shard_residency", "split_search",
+                       # pure perf knob: the scan window re-partitions
+                       # the SAME iteration stream (models byte-equal
+                       # under any windowing — tests/test_fused_scan.py),
+                       # so a resume may legally change or disable it
+                       "fused_scan_iters"}
 
 
 def _params_fingerprint(params) -> Dict[str, str]:

@@ -9,7 +9,10 @@ test sets:
 - ``LIGHTGBM_TPU_CHECKPOINT=<dir>`` — auto-checkpoint every iteration
   AND auto-resume from the newest valid snapshot,
 - ``LIGHTGBM_TPU_FAULT_INJECT=kill@N`` — SIGKILL mid-train (the run
-  the parent expects to die with -SIGKILL).
+  the parent expects to die with -SIGKILL),
+- ``CKPT_WORKER_PARAMS=<json>`` — extra params merged over the
+  defaults (the fused-scan resume tests pass ``fused_scan_iters`` and
+  drop the host-RNG ``feature_fraction`` so the scan engages).
 
 On completion the model is saved to ``<model_out>`` and ``WORKER DONE``
 is printed; the parent compares the saved model byte-for-byte against
@@ -46,7 +49,12 @@ def make_data():
 def main() -> int:
     model_out = sys.argv[1]
     X, y = make_data()
-    bst = lgb.train(PARAMS, lgb.Dataset(X, label=y),
+    params = dict(PARAMS)
+    extra = os.environ.get("CKPT_WORKER_PARAMS")
+    if extra:
+        import json
+        params.update(json.loads(extra))
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
                     num_boost_round=NUM_ROUNDS)
     bst.save_model(model_out)
     print(f"WORKER DONE iterations={bst.current_iteration()}")

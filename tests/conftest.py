@@ -28,6 +28,25 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True)
+def _isolate_process_fault_log():
+    """Tier-1 order independence: the PROCESS-LEVEL fault-event log
+    (resilience.faults.FAULT_EVENTS) is drained by whichever telemetry
+    recorder runs next, so a test that provokes watchdog timeouts /
+    injected faults without attaching a recorder (the
+    test_distributed_resilience in-process chaos tests) used to leak
+    its events into an unrelated later test's JSONL stream —
+    test_jsonl_schema_one_valid_event_per_iteration counted 15 lines
+    for 5 iterations whenever the distributed module ran first.
+    Snapshot-and-clear after every test so each starts with an empty
+    process log; tests that assert on these events consume them
+    inside the test body."""
+    yield
+    from lightgbm_tpu.resilience.faults import FAULT_EVENTS, drain_events
+    if FAULT_EVENTS:
+        drain_events(FAULT_EVENTS)
+
+
 @pytest.fixture(scope="session")
 def rng():
     return np.random.RandomState(42)

@@ -1,0 +1,769 @@
+"""Multi-iteration fused scan (gbdt.py _dispatch_scan_window /
+_get_scan_fn; docs/FUSED.md).
+
+A whole window of boosting iterations runs as ONE lax.scan program with
+donated score/bagging carries; trees come back as one batched pack per
+window and the driver pops them per iteration, so callbacks, telemetry
+and the one-late guard drain keep their exact per-iteration semantics.
+
+Contract under test: for every scan-eligible config the scan-trained
+model is BYTE-IDENTICAL to the per-iteration fused path (and the fused
+path to eager, modulo the documented float tolerance), windows
+partition the iteration stream without changing it (tails, natural
+early stop, checkpoint cadence, SIGKILL resume), and fault injection
+fires at the correct ABSOLUTE iteration inside a window.
+"""
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import callback as cbm
+from lightgbm_tpu.models.gbdt import GBDTBooster, resolve_scan_iters
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+@pytest.fixture
+def data():
+    # same shape/seed as tests/test_fused_iter.py: the fused-vs-eager
+    # float contract (rtol 1e-5) is calibrated on this distribution
+    rs = np.random.RandomState(7)
+    X = rs.randn(3000, 10)
+    y = ((X[:, :4] @ rs.randn(4) + 0.3 * rs.randn(3000)) > 0).astype(float)
+    return X, y
+
+
+def _train(params, X, y, n=10, mode="scan", callbacks=None, W=4,
+           resume_from=None):
+    """mode: 'scan' (windows of W), 'fused' (per-iteration fused),
+    'eager' (fused gate forced off)."""
+    p = dict(params, verbosity=-1)
+    if mode == "scan":
+        p["fused_scan_iters"] = W
+    orig = None
+    if mode == "eager":
+        orig = GBDTBooster._fused_ok
+        GBDTBooster._fused_ok = lambda self: False
+    try:
+        return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=n,
+                         callbacks=callbacks, resume_from=resume_from)
+    finally:
+        if orig is not None:
+            GBDTBooster._fused_ok = orig
+
+
+def _model_bytes(bst, ignore=()) -> str:
+    """model_to_string minus the fused_scan_iters params echo — the
+    only legal difference between a scan- and a fused-trained model —
+    plus any extra ``ignore`` params-echo prefixes a test legitimately
+    varies (e.g. num_iterations on resume-to-total runs)."""
+    skip = ("[fused_scan_iters",) + tuple(ignore)
+    return "\n".join(ln for ln in bst.model_to_string().split("\n")
+                     if not ln.startswith(skip))
+
+
+def _assert_byte_identical(a, b):
+    assert _model_bytes(a) == _model_bytes(b)
+
+
+# ---------------------------------------------------------------------
+# byte-identity battery: growers x hist_comm wires, plus the sampling /
+# quantization / multiclass arms the fused path carries
+# ---------------------------------------------------------------------
+
+# every grower's loop-carry plumbing (incl. the comm_ef error-feedback
+# slots, inert on one device but ALLOCATED and threaded per tree for
+# the int wires) must survive being traced inside the scan body. The
+# full grower x wire cross product compiles ~9 scan programs; tier-1
+# keeps one arm per grower plus one int wire per grower-class and the
+# redundant combinations ride the slow tier (each wire arm differs
+# only in the inert EF slot dtype threading on one device).
+_T1 = {"compact-f32", "compact-int8", "masked-int16", "level-f32"}
+GROWER_ARMS = [
+    pytest.param(
+        f"{grower}-{wire}",
+        dict({"objective": "binary", "num_leaves": 15,
+              "hist_comm": wire},
+             **({"grower": grower, "max_depth": 4} if grower == "level"
+                else {"grower": grower})),
+        id=f"{grower}-{wire}",
+        marks=([] if f"{grower}-{wire}" in _T1
+               else [pytest.mark.slow]))
+    for grower in ("compact", "masked", "level")
+    for wire in ("f32", "int16", "int8")
+]
+
+EXTRA_ARMS = [
+    pytest.param(name, params, id=name)
+    for name, params in [
+        ("bagging", {"objective": "binary", "num_leaves": 15,
+                     "bagging_fraction": 0.7, "bagging_freq": 2,
+                     "bagging_seed": 5}),
+        ("pos_neg_bagging", {"objective": "binary", "num_leaves": 15,
+                             "pos_bagging_fraction": 0.8,
+                             "neg_bagging_fraction": 0.6,
+                             "bagging_freq": 1}),
+        ("quantized", {"objective": "binary", "num_leaves": 15,
+                       "use_quantized_grad": True}),
+        ("bynode", {"objective": "binary", "num_leaves": 15,
+                    "feature_fraction_bynode": 0.8}),
+        ("regression_monotone", {"objective": "regression",
+                                 "num_leaves": 15,
+                                 "monotone_constraints":
+                                     [1, -1] + [0] * 8}),
+    ]
+]
+
+
+@pytest.mark.parametrize("name,params", GROWER_ARMS + EXTRA_ARMS)
+def test_scan_matches_fused_and_eager(name, params, data):
+    X, y = data
+    yy = X[:, 0] * 2 + X[:, 1] \
+        if params["objective"] == "regression" else y
+    # n=10 with W=4 also exercises the window tail (10 = 4 + 4 + 2)
+    a = _train(params, X, yy, mode="scan")
+    b = _train(params, X, yy, mode="fused")
+    assert a._engine._scan_fns, "scan path did not engage"
+    assert not b._engine._scan_fns
+    _assert_byte_identical(a, b)
+    # fused vs eager keeps the established float contract. The wire
+    # mode is inert on one device (comms.make_hist_psum_ef pins f32
+    # without an axis), so the eager leg runs once per grower config —
+    # the int arms prove the scan composes with the EF carry plumbing,
+    # not a different eager numeric path.
+    if params.get("hist_comm", "f32") != "f32":
+        return
+    c = _train(params, X, yy, mode="eager")
+    for ta, tc in zip(a._models, c._models):
+        assert ta.num_leaves == tc.num_leaves
+        nn = ta.num_nodes
+        assert np.array_equal(ta.split_feature[:nn],
+                              tc.split_feature[:nn])
+        np.testing.assert_allclose(ta.leaf_value, tc.leaf_value,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_scan_multiclass_matches_fused(data):
+    X, y = data
+    y3 = (y + (X[:, 5] > 0)).astype(float)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7}
+    a = _train(params, X, y3, mode="scan", W=3, n=9)
+    b = _train(params, X, y3, mode="fused", n=9)
+    assert a._engine._scan_fns
+    _assert_byte_identical(a, b)
+
+
+def test_scan_window_larger_than_run(data):
+    """W > num_boost_round: one window, clamped to end-of-training."""
+    X, y = data
+    params = {"objective": "binary", "num_leaves": 15}
+    a = _train(params, X, y, mode="scan", W=64, n=6)
+    b = _train(params, X, y, mode="fused", n=6)
+    assert a._engine._scan_fns
+    assert (64, False) not in a._engine._scan_fns, \
+        "window was not clamped to the 6 remaining iterations"
+    _assert_byte_identical(a, b)
+
+
+# ---------------------------------------------------------------------
+# eligibility / fallback
+# ---------------------------------------------------------------------
+
+def test_feature_fraction_falls_back_to_per_iteration(data):
+    """feature_fraction < 1 consumes a HOST RandomState draw per tree —
+    the scan cannot carry that stream; the per-iteration fused path
+    must engage instead and keep matching eager."""
+    X, y = data
+    params = {"objective": "binary", "num_leaves": 15,
+              "feature_fraction": 0.7}
+    a = _train(params, X, y, mode="scan")
+    b = _train(params, X, y, mode="fused")
+    assert not a._engine._scan_fns, \
+        "scan must not engage with host-RNG column sampling"
+    assert a._engine._fused_fn is not None
+    _assert_byte_identical(a, b)
+
+
+def test_unknown_callback_pins_lookahead(data):
+    """An arbitrary user callback may read booster state every
+    iteration; the engine must pin the lookahead to 1 so the scan
+    never runs ahead of it."""
+    X, y = data
+    seen = []
+    a = _train({"objective": "binary", "num_leaves": 15}, X, y,
+               mode="scan",
+               callbacks=[lambda env: seen.append(env.iteration)])
+    assert len(seen) == 10
+    assert not a._engine._scan_fns, \
+        "scan engaged under an unknown per-iteration callback"
+
+
+def test_train_set_in_valid_sets_bounds_windows_to_metric_freq(data):
+    """valid_sets=[train_set] keeps engine.valid_sets empty (scan stays
+    eligible) but the engine loop then evaluates the TRAIN score inline
+    every metric_freq iterations — a window running past an eval point
+    would report future (uncommitted-lookahead) metrics. metric_freq=1
+    (default) must disable windows outright; an aligned metric_freq
+    must keep the reported metrics identical to the per-iteration
+    path."""
+    X, y = data
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+
+    def run(scan, metric_freq):
+        rec = {}
+        ds = lgb.Dataset(X, label=y)
+        p = dict(params, metric_freq=metric_freq)
+        if scan:
+            p["fused_scan_iters"] = 4
+        bst = lgb.train(p, ds, num_boost_round=8, valid_sets=[ds],
+                        callbacks=[cbm.record_evaluation(rec)])
+        return bst, rec
+
+    a, rec_a = run(scan=True, metric_freq=1)
+    assert not a._engine._scan_fns, \
+        "per-iteration train-set eval must pin the lookahead to 1"
+    b, rec_b = run(scan=True, metric_freq=4)
+    assert b._engine._scan_fns, \
+        "an aligned metric_freq must keep windows enabled"
+    c, rec_c = run(scan=False, metric_freq=4)
+    assert rec_b == rec_c, \
+        "train-set metrics at eval points diverged from the " \
+        "per-iteration path (a window ran past an eval)"
+    _assert_byte_identical(b, c)
+
+
+def test_oom_retry_bag_rederivation_invariant(data):
+    """The dispatch-retry path re-derives a consumed (donated) bagging
+    carry by re-drawing at the iteration the entry bag was KEYED at
+    (the last refresh for a cache-served bag). Pin the invariant that
+    re-derivation relies on: a fresh draw at (it // freq) * freq
+    reproduces the sequentially-maintained cache byte-for-byte."""
+    X, y = data
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 15,
+                              "bagging_fraction": 0.7,
+                              "bagging_freq": 3, "bagging_seed": 5,
+                              "verbosity": -1},
+                      train_set=lgb.Dataset(X, label=y))
+    eng = bst._engine
+    for _ in range(5):
+        bst.update()   # per-iteration path; cache last refreshed at 3
+    cached = np.asarray(eng._cached_bag)
+    eng._cached_bag = None
+    rederived = np.asarray(eng._row_weights((5 // 3) * 3, None, None))
+    np.testing.assert_array_equal(cached, rederived)
+
+
+@pytest.mark.parametrize("rollback_at", [3, 5],
+                         ids=["on-cadence", "off-cadence"])
+def test_rollback_mid_window_keeps_bagging_stream(data, rollback_at):
+    """rollback_one_iter with lookahead still queued aborts the window
+    (score rebuilt from trees) AND re-derives the bagging cache at the
+    last refresh BEFORE the post-rollback iteration — continuing must
+    reuse the same in-bag draw the per-iteration path would, not fork
+    the stream with an off-cadence fresh draw. Both cadence phases of
+    the rollback point matter: iter_ ON the bagging cadence (3, where
+    a pre-decrement re-derivation would wrongly be skipped) and off
+    it (5)."""
+    X, y = data
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "bagging_fraction": 0.7, "bagging_freq": 3,
+              "bagging_seed": 5}
+
+    def run(scan):
+        p = dict(params)
+        if scan:
+            p["fused_scan_iters"] = 6
+        bst = lgb.Booster(params=p, train_set=lgb.Dataset(X, label=y))
+
+        def step():
+            # emulate the engine loop's lookahead: never past the
+            # 8-iteration end of this manual run
+            if scan:
+                bst._engine._scan_horizon = 8 - bst._engine.iter_
+            bst.update()
+
+        for _ in range(rollback_at):
+            step()
+        bst.rollback_one_iter()
+        while bst._engine.iter_ < 8:
+            step()
+        return bst
+
+    a = run(scan=True)   # window [0..5]; rollback lands mid-window
+    b = run(scan=False)
+    assert a._engine._scan_fns
+    # the bagging caches of both paths must end keyed at the same
+    # refresh draw — an off-cadence re-derivation after the abort
+    # would fork the stream here
+    np.testing.assert_array_equal(np.asarray(a._engine._cached_bag),
+                                  np.asarray(b._engine._cached_bag))
+    # score after the abort is rebuilt from trees (documented last-ulp
+    # forfeit), so compare structure exactly and leaves to tolerance
+    assert len(a._models) == len(b._models) == 8
+    for ta, tb in zip(a._models, b._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        assert np.array_equal(ta.split_feature[:nn],
+                              tb.split_feature[:nn])
+        np.testing.assert_allclose(ta.leaf_value, tb.leaf_value,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_reset_parameter_invalidates_scan_programs(data):
+    """The scan body BAKES the bagging fractions into its traced
+    closure (unlike the per-iteration fused fn, whose row weights are
+    operands) — reset_parameter must drop the cached window programs
+    so the next dispatch re-traces with the new cfg instead of
+    silently sampling at the old fraction."""
+    X, y = data
+
+    def run(scan):
+        p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+             "bagging_fraction": 0.8, "bagging_freq": 1,
+             "bagging_seed": 5}
+        if scan:
+            p["fused_scan_iters"] = 4
+        bst = lgb.Booster(params=p, train_set=lgb.Dataset(X, label=y))
+
+        def step():
+            if scan:
+                bst._engine._scan_horizon = 8 - bst._engine.iter_
+            bst.update()
+
+        for _ in range(4):
+            step()
+        bst.reset_parameter({"bagging_fraction": 0.5})
+        for _ in range(4):
+            step()
+        return bst
+
+    a = run(scan=True)
+    b = run(scan=False)
+    assert a._engine._scan_fns, "post-reset window did not re-trace"
+    _assert_byte_identical(a, b)
+
+
+def test_fused_ok_flip_mid_pend_aborts_lookahead(data):
+    """add_valid between direct update() calls flips _fused_ok while
+    lookahead is still queued: the eager path must train from the
+    committed score, not the window-ahead carry, and the stale packs
+    must never be popped on top of eager trees."""
+    X, y = data
+    p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+         "fused_scan_iters": 6}
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.Booster(params=p, train_set=ds)
+    eng = bst._engine
+    eng._scan_horizon = 8
+    for _ in range(3):
+        bst.update()          # window [0..5] dispatched, 3 pops
+    assert eng._scan_pend is not None
+    ds.construct()
+    bst.add_valid(lgb.Dataset(X[:500], label=y[:500], reference=ds),
+                  "v")
+    for _ in range(5):
+        bst.update()          # eager path (valid set) from it 3
+    assert eng._scan_pend is None, "stale packs survived the flip"
+    assert bst.current_iteration() == 8
+    assert len(bst._models) == 8
+
+    # per-iteration reference: same add_valid at the same iteration
+    bst2 = lgb.Booster(params={k: v for k, v in p.items()
+                               if k != "fused_scan_iters"},
+                       train_set=lgb.Dataset(X, label=y))
+    for _ in range(3):
+        bst2.update()
+    ds2 = bst2._engine.train_set
+    bst2.add_valid(lgb.Dataset(X[:500], label=y[:500],
+                               reference=ds2), "v")
+    for _ in range(5):
+        bst2.update()
+    for ta, tb in zip(bst._models, bst2._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        assert np.array_equal(ta.split_feature[:nn],
+                              tb.split_feature[:nn])
+        np.testing.assert_allclose(ta.leaf_value, tb.leaf_value,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_learning_rate_reset_mid_window_takes_effect_next_iter(data):
+    """reset_parameter({'learning_rate': ...}) mid-window discards the
+    lookahead still scored at the old rate — the new rate applies from
+    the very next iteration, like the per-iteration path."""
+    X, y = data
+
+    def run(scan):
+        p = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+        if scan:
+            p["fused_scan_iters"] = 6
+        bst = lgb.Booster(params=p, train_set=lgb.Dataset(X, label=y))
+
+        def step():
+            if scan:
+                bst._engine._scan_horizon = 8 - bst._engine.iter_
+            bst.update()
+
+        for _ in range(3):
+            step()            # scan: mid-window of [0..5]
+        bst.reset_parameter({"learning_rate": 0.05})
+        for _ in range(5):
+            step()
+        return bst
+
+    a = run(scan=True)
+    b = run(scan=False)
+    # the abort's score rebuild forfeits the last ulp; structure must
+    # match exactly, leaves to the established tolerance
+    assert len(a._models) == len(b._models) == 8
+    for ta, tb in zip(a._models, b._models):
+        assert ta.num_leaves == tb.num_leaves
+        nn = ta.num_nodes
+        assert np.array_equal(ta.split_feature[:nn],
+                              tb.split_feature[:nn])
+        np.testing.assert_allclose(ta.leaf_value, tb.leaf_value,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_resolve_scan_iters_env_is_capped(monkeypatch):
+    monkeypatch.delenv("LIGHTGBM_TPU_DISABLE_SCAN", raising=False)
+    monkeypatch.setenv("LIGHTGBM_TPU_AUTO_SCAN_ITERS", "100000")
+    assert resolve_scan_iters("auto") == 1024, \
+        "the env opt-in must honor the same window ceiling Config " \
+        "validation enforces"
+
+
+def test_known_safe_callbacks_keep_scan_enabled(data):
+    X, y = data
+    rec = {}
+    a = _train({"objective": "binary", "num_leaves": 15}, X, y,
+               mode="scan", callbacks=[cbm.record_evaluation(rec)])
+    assert a._engine._scan_fns, \
+        "record_evaluation is scan-inert and must not disable windows"
+
+
+def test_direct_update_api_stays_per_iteration(data):
+    """Raw Booster.update() callers get no engine-computed lookahead:
+    the default horizon of 1 keeps mid-training state reads exact."""
+    X, y = data
+    bst = lgb.Booster(params={"objective": "binary", "num_leaves": 15,
+                              "fused_scan_iters": 8, "verbosity": -1},
+                      train_set=lgb.Dataset(X, label=y))
+    for _ in range(4):
+        bst.update()
+    assert not bst._engine._scan_fns
+    assert bst._engine._fused_fn is not None
+
+
+def test_custom_fobj_never_scans(data):
+    X, y = data
+
+    def fobj(preds, ds):
+        lbl = np.asarray(ds.get_label())
+        p = 1.0 / (1.0 + np.exp(-preds))
+        return p - lbl, p * (1 - p)
+
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "none", "num_leaves": 15,
+                     "fused_scan_iters": 4, "verbosity": -1}, ds,
+                    num_boost_round=5, fobj=fobj)
+    assert not bst._engine._scan_fns
+    assert bst.current_iteration() == 5
+
+
+# ---------------------------------------------------------------------
+# natural early stop: the window stops at the exact tree
+# ---------------------------------------------------------------------
+
+def _stall_data():
+    rs = np.random.RandomState(3)
+    X = rs.randn(500, 3)
+    y = (X[:, 0] > 0).astype(float) * 2.0
+    return X, y
+
+
+def test_natural_stop_at_exact_tree():
+    """A perfectly-fittable target with lr=1.0 stalls after a few
+    iterations; a window precomputed past the stall must discard the
+    lookahead slots and stop at the same tree as per-iteration."""
+    X, y = _stall_data()
+    params = {"objective": "regression", "num_leaves": 4,
+              "learning_rate": 1.0, "min_data_in_leaf": 5}
+    a = _train(params, X, y, mode="scan", W=5, n=12)
+    b = _train(params, X, y, mode="fused", n=12)
+    assert a._engine._scan_fns
+    assert a.current_iteration() == b.current_iteration() < 12
+    assert len(a._models) == len(b._models)
+    _assert_byte_identical(a, b)
+
+
+def test_score_frozen_at_stop_point():
+    """The scan body's stop carry gates the score update: the engine's
+    final score must equal the per-iteration path's (no contribution
+    from the discarded lookahead slots)."""
+    X, y = _stall_data()
+    params = {"objective": "regression", "num_leaves": 4,
+              "learning_rate": 1.0, "min_data_in_leaf": 5}
+    a = _train(params, X, y, mode="scan", W=5, n=12)
+    b = _train(params, X, y, mode="fused", n=12)
+    np.testing.assert_array_equal(a._engine.current_score(0),
+                                  b._engine.current_score(0))
+
+
+# ---------------------------------------------------------------------
+# fault injection inside a window (resilience/faults.py)
+# ---------------------------------------------------------------------
+
+def test_nan_grad_fires_at_absolute_iteration_raise(data, monkeypatch):
+    """nan_grad@7 poisons window slot 3 of the [4..7] window; the
+    one-late drain must raise naming iteration 7, exactly like the
+    per-iteration path."""
+    X, y = data
+    monkeypatch.setenv("LIGHTGBM_TPU_FAULT_INJECT", "nan_grad@7")
+    with pytest.raises(lgb.LightGBMError, match="iteration 7"):
+        _train({"objective": "binary", "num_leaves": 15}, X, y,
+               mode="scan", n=12)
+
+
+def test_nan_grad_skip_tree_inside_window_matches_fused(data,
+                                                        monkeypatch):
+    X, y = data
+    monkeypatch.setenv("LIGHTGBM_TPU_FAULT_INJECT", "nan_grad@7")
+    params = {"objective": "binary", "num_leaves": 15,
+              "nonfinite_policy": "skip_tree"}
+    a = _train(params, X, y, mode="scan", n=12)
+    b = _train(params, X, y, mode="fused", n=12)
+    assert a._engine._scan_fns
+    # the poisoned iteration's tree is demoted to a constant in BOTH
+    assert a._models[7].num_leaves == 1 == b._models[7].num_leaves
+    assert a.current_iteration() == 12 == b.current_iteration()
+    _assert_byte_identical(a, b)
+    ev = [f for f in a._engine.fault_log if f["kind"] == "nonfinite"]
+    assert ev and ev[0]["iteration"] == 7
+
+
+def test_oom_injection_falls_back_to_per_iteration(data, monkeypatch):
+    """oom@N is a HOST-side injection at dispatch time — mid-window
+    slots have no dispatch, so the scan gate defers to the
+    per-iteration fused path while an oom fault is scheduled. The
+    fault event firing at the exact iteration 3 proves iteration 3 was
+    its own dispatch; once the one-shot injection is consumed the scan
+    may legally re-engage for the remaining iterations."""
+    X, y = data
+    monkeypatch.setenv("LIGHTGBM_TPU_FAULT_INJECT", "oom@3")
+    params = {"objective": "binary", "num_leaves": 15}
+    a = _train(params, X, y, mode="scan", n=8)
+    ev = [f for f in a._engine.fault_log if f["kind"] == "oom"]
+    assert ev and ev[0]["iteration"] == 3, \
+        "oom@3 must fire at its exact iteration (a window covering " \
+        "iteration 3 would have skipped the host injection)"
+    assert a.current_iteration() == 8
+    monkeypatch.setenv("LIGHTGBM_TPU_FAULT_INJECT", "oom@3")
+    b = _train(params, X, y, mode="fused", n=8)
+    _assert_byte_identical(a, b)
+
+
+# ---------------------------------------------------------------------
+# checkpoint cadence + resume landing mid-window
+# ---------------------------------------------------------------------
+
+def test_checkpoint_cadence_bounds_windows_and_resume_is_byte_identical(
+        data, tmp_path):
+    """every_n_iters=5 with W=4: windows end on checkpoint boundaries,
+    snapshots carry committed state, and a resume from iteration 5
+    (mid-window relative to the uninterrupted run's window grid)
+    retrains to a byte-identical model."""
+    X, y = data
+    params = {"objective": "binary", "num_leaves": 15,
+              "bagging_fraction": 0.7, "bagging_freq": 3}
+    ck = str(tmp_path / "ck")
+    full = _train(params, X, y, mode="scan", n=12,
+                  callbacks=[lgb.checkpoint(ck, every_n_iters=5,
+                                            keep=10)])
+    snaps = sorted(glob.glob(os.path.join(ck, "ckpt_*.npz")))
+    its = [int(os.path.basename(s)[5:-4]) for s in snaps]
+    assert its == [5, 10, 12], its
+    # keep only the iteration-5 snapshot and resume to 12
+    for s in snaps:
+        if not s.endswith("00000005.npz"):
+            os.unlink(s)
+    resumed = _train(params, X, y, mode="scan", n=12, W=4,
+                     resume_from=ck)
+    assert resumed.current_iteration() == 12
+    _assert_byte_identical(full, resumed)
+    # and a resume that DISABLES the scan must also match
+    resumed_fused = _train(params, X, y, mode="fused", n=12,
+                           resume_from=ck)
+    _assert_byte_identical(full, resumed_fused)
+
+
+def test_init_model_offset_keeps_checkpoints_on_cadence(data, tmp_path):
+    """Continued training (init_model) offsets the engine's iter_ from
+    the loop index; the Checkpoint callback fires on iter_, so the
+    window bound must key off iter_ too — snapshots land exactly on
+    the every_n grid with committed state, and resuming reproduces the
+    model byte-for-byte."""
+    X, y = data
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+    base = _train(params, X, y, mode="fused", n=3)
+    ck = str(tmp_path / "ck")
+
+    def cont(scan, resume_from=None, rounds=10):
+        p = dict(params)
+        if scan:
+            p["fused_scan_iters"] = 8
+        return lgb.train(p, lgb.Dataset(X, label=y),
+                         num_boost_round=rounds, init_model=base,
+                         resume_from=resume_from,
+                         callbacks=[lgb.checkpoint(
+                             ck, every_n_iters=5, keep=10)])
+
+    a = cont(scan=True)
+    assert a._engine._scan_fns
+    snaps = sorted(glob.glob(os.path.join(ck, "ckpt_*.npz")))
+    its = [int(os.path.basename(s)[5:-4]) for s in snaps]
+    assert its == [5, 10, 13], \
+        f"snapshots off the iter_-keyed cadence: {its}"
+    b = cont(scan=False)
+    _assert_byte_identical(a, b)
+    # resume from the mid-run snapshot reproduces the model (resume
+    # counts TOTAL iterations, so 13 matches a's init(3) + 10)
+    for s in snaps:
+        if not s.endswith("00000005.npz"):
+            os.unlink(s)
+    c = cont(scan=True, resume_from=ck, rounds=13)
+    assert _model_bytes(a, ignore=("[num_iterations",)) \
+        == _model_bytes(c, ignore=("[num_iterations",))
+
+
+def test_horizon_reset_after_train_returns():
+    """A booster returned by train() (keep_training_booster semantics:
+    the engine survives) must not keep a stale multi-iteration horizon
+    — a natural stall breaks the loop early, and direct update() calls
+    afterwards have no engine loop bounding callbacks/eval."""
+    X, y = _stall_data()
+    params = {"objective": "regression", "num_leaves": 4,
+              "learning_rate": 1.0, "min_data_in_leaf": 5,
+              "fused_scan_iters": 5, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y),
+                    num_boost_round=12, keep_training_booster=True)
+    assert bst.current_iteration() < 12  # stalled -> early break
+    assert bst._engine._scan_horizon == 1, \
+        "train() leaked a multi-iteration horizon to the direct API"
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(420)
+def test_sigkill_mid_window_resume_byte_identical(tmp_path):
+    """SIGKILL at iteration 12 with checkpoints every 5 and W=4: the
+    kill lands with a window in flight; the supervised re-run resumes
+    from the newest committed snapshot and the final model is
+    byte-identical to an uninterrupted run (tests/ckpt_worker.py)."""
+    scan_params = json.dumps({"fused_scan_iters": 4,
+                              "feature_fraction": 1.0})
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["CKPT_WORKER_PARAMS"] = scan_params
+    env["LIGHTGBM_TPU_CHECKPOINT"] = str(tmp_path / "ck")
+    env["LIGHTGBM_TPU_CHECKPOINT_EVERY"] = "5"
+    env["LIGHTGBM_TPU_FAULT_INJECT"] = "kill@12"
+    worker = [sys.executable, os.path.join(_DIR, "ckpt_worker.py")]
+
+    killed_model = str(tmp_path / "model_killed.txt")
+    p = subprocess.run(worker + [killed_model], env=env,
+                       capture_output=True, timeout=300)
+    assert p.returncode == -signal.SIGKILL, p.stdout.decode()
+
+    env.pop("LIGHTGBM_TPU_FAULT_INJECT")
+    p = subprocess.run(worker + [killed_model], env=env,
+                       capture_output=True, timeout=300)
+    assert p.returncode == 0, p.stdout.decode() + p.stderr.decode()
+    assert b"WORKER DONE iterations=20" in p.stdout
+
+    env2 = dict(os.environ)
+    env2["JAX_PLATFORMS"] = "cpu"
+    env2["CKPT_WORKER_PARAMS"] = scan_params
+    env2["LIGHTGBM_TPU_CHECKPOINT"] = str(tmp_path / "ck2")
+    env2["LIGHTGBM_TPU_CHECKPOINT_EVERY"] = "5"
+    clean_model = str(tmp_path / "model_clean.txt")
+    p = subprocess.run(worker + [clean_model], env=env2,
+                       capture_output=True, timeout=300)
+    assert p.returncode == 0, p.stdout.decode() + p.stderr.decode()
+
+    with open(killed_model) as a, open(clean_model) as b:
+        assert a.read() == b.read()
+
+
+# ---------------------------------------------------------------------
+# telemetry: one event per iteration, window-position field
+# ---------------------------------------------------------------------
+
+def test_telemetry_events_stay_per_iteration_with_scan_field(
+        data, tmp_path):
+    X, y = data
+    path = str(tmp_path / "scan.jsonl")
+    _train({"objective": "binary", "num_leaves": 15}, X, y,
+           mode="scan", n=10, callbacks=[cbm.telemetry(path)])
+    evs = [json.loads(ln) for ln in open(path) if ln.strip()]
+    it_evs = [e for e in evs if e.get("event") == "iteration"]
+    assert len(it_evs) == 10
+    assert [e["iteration"] for e in it_evs] == list(range(10))
+    # windows of 4 over 10 iterations: dispatches at 0, 4, 8
+    marks = [(e["scan"]["pos"], e["scan"]["dispatch"])
+             for e in it_evs if e.get("scan")]
+    assert len(marks) == 10
+    assert sum(1 for _, d in marks if d) == 3
+    assert marks[0] == (0, True) and marks[1] == (1, False)
+    from lightgbm_tpu.obs import summarize_events
+    summary = summarize_events(path)
+    assert summary["scan_windows"] == 3
+    assert summary["scan_iterations"] == 10
+
+
+def test_telemetry_scan_field_null_on_per_iteration_paths(
+        data, tmp_path):
+    X, y = data
+    path = str(tmp_path / "noscan.jsonl")
+    _train({"objective": "binary", "num_leaves": 15}, X, y,
+           mode="fused", n=5, callbacks=[cbm.telemetry(path)])
+    evs = [json.loads(ln) for ln in open(path) if ln.strip()]
+    assert all(e.get("scan") is None for e in evs
+               if e.get("event") == "iteration")
+
+
+# ---------------------------------------------------------------------
+# config resolution
+# ---------------------------------------------------------------------
+
+def test_resolve_scan_iters_matrix(monkeypatch):
+    monkeypatch.delenv("LIGHTGBM_TPU_AUTO_SCAN_ITERS", raising=False)
+    monkeypatch.delenv("LIGHTGBM_TPU_DISABLE_SCAN", raising=False)
+    # auto stays per-iteration until the bench verdict flips it
+    assert resolve_scan_iters("auto") == 1
+    assert resolve_scan_iters(8) == 8
+    monkeypatch.setenv("LIGHTGBM_TPU_AUTO_SCAN_ITERS", "16")
+    assert resolve_scan_iters("auto") == 16
+    # the kill switch pins EVERYTHING back to per-iteration
+    monkeypatch.setenv("LIGHTGBM_TPU_DISABLE_SCAN", "1")
+    assert resolve_scan_iters("auto") == 1
+    assert resolve_scan_iters(8) == 1
+
+
+def test_fused_scan_iters_validation():
+    from lightgbm_tpu.config import Config
+    assert Config.from_params(
+        {"fused_scan_iters": 8}).fused_scan_iters == 8
+    assert Config.from_params({}).fused_scan_iters == "auto"
+    with pytest.raises(ValueError):
+        Config.from_params({"fused_scan_iters": 0})
+    with pytest.raises(ValueError):
+        Config.from_params({"fused_scan_iters": "sometimes"})
+    with pytest.raises(ValueError):
+        Config.from_params({"fused_scan_iters": 100000})

@@ -111,6 +111,12 @@ KNOWN_JITTED = {
     ("ops/predict.py", "predict_leaf_raw"),
     ("ranking.py", "_lambdarank_grads"),
     ("models/gbdt.py", "GBDTBooster._get_fused_fn.step"),
+    # the shared one-iteration body and the multi-iteration scan
+    # program built over it (docs/FUSED.md) — de-jitting any of these
+    # silently re-opens the per-iteration dispatch hole
+    ("models/gbdt.py", "_fused_iter_step"),
+    ("models/gbdt.py", "GBDTBooster._get_scan_fn.scan_fn"),
+    ("models/gbdt.py", "GBDTBooster._get_scan_fn.scan_fn.body"),
 }
 
 
@@ -331,7 +337,7 @@ def test_nonfinite_guard_stays_inside_jitted_step():
     with open(path, encoding="utf-8") as fh:
         tree = ast.parse(fh.read(), filename=path)
 
-    guard_helpers = {"_gh_flag_clamp", "_leaf_guard"}
+    guard_helpers = {"_gh_flag_clamp", "_leaf_value_guard"}
 
     def _calls(fn_node):
         names = set()
@@ -343,17 +349,28 @@ def test_nonfinite_guard_stays_inside_jitted_step():
                     names.add(n.func.id)
         return names
 
-    step = _function_node(tree, ["_get_fused_fn", "step"])
-    step_calls = _calls(step)
-    assert "isfinite" in step_calls or (step_calls & guard_helpers), (
-        "the non-finite guard left the fused jitted step: "
-        "_get_fused_fn.step must trace jnp.isfinite (directly or via "
-        "_gh_flag_clamp/_leaf_guard), not check eagerly")
-    for helper in guard_helpers & step_calls:
+    # the guard lives in the shared one-iteration body
+    # (_fused_iter_step) that BOTH fused entry points trace: the
+    # per-iteration jit wrapper (_get_fused_fn.step) and the
+    # multi-iteration scan body (_get_scan_fn.scan_fn.body)
+    body = _function_node(tree, ["_fused_iter_step"])
+    body_calls = _calls(body)
+    assert "isfinite" in body_calls or (body_calls & guard_helpers), (
+        "the non-finite guard left the fused iteration body: "
+        "_fused_iter_step must trace jnp.isfinite (directly or via "
+        "_gh_flag_clamp/_leaf_value_guard), not check eagerly")
+    for helper in guard_helpers & body_calls:
         node = _function_node(tree, [helper])
         assert "isfinite" in _calls(node), (
             f"{helper} no longer reduces via jnp.isfinite — the fused "
             "guard is gone")
+    for entry in (["_get_fused_fn", "step"],
+                  ["_get_scan_fn", "scan_fn", "body"]):
+        node = _function_node(tree, entry)
+        assert "_fused_iter_step" in _calls(node), (
+            f"{'.'.join(entry)} no longer traces _fused_iter_step — "
+            "the two fused paths have diverged from the one shared "
+            "iteration body")
 
     # (2) no host materialization in the fused iteration driver —
     # now the analyzer's job: _train_one_iter_fused is hot-marked and
@@ -370,6 +387,49 @@ def test_nonfinite_guard_stays_inside_jitted_step():
     assert "GBDTBooster._train_one_iter_fused" in hot, (
         "_train_one_iter_fused lost its '# tpulint: hot' marker — "
         "TPL002 no longer guards the fused driver")
+    # the scan drivers must stay hot-marked too: the window-boundary
+    # batched fetch in _dispatch_scan_window is the ONE baselined sync
+    # of the scan pipeline (docs/FUSED.md), and TPL002 only watches it
+    # — and the pure-host _pop_scan_iter — through these markers
+    for fn in ("GBDTBooster._dispatch_scan_window",
+               "GBDTBooster._pop_scan_iter"):
+        assert fn in hot, (
+            f"{fn} lost its '# tpulint: hot' marker — TPL002 no "
+            "longer guards the scan-window drivers")
+
+
+def test_scan_body_device_get_mutation_fails(tmp_path):
+    """The acceptance mutation (ISSUE 11): a per-iteration
+    ``jax.device_get`` sneaking INSIDE the traced scan body — the
+    exact per-iteration sync the window exists to delete — must fail
+    lint with the expected stable id."""
+    anchor = ("                new_score, outs, flags = "
+              "_fused_iter_step(")
+    res = _lint_mutated(
+        "models/gbdt.py",
+        lambda src: src.replace(
+            anchor,
+            "                jax.device_get(score)\n" + anchor),
+        ["TPL002"], tmp_path)
+    fids = [f.fid for f in res.findings]
+    assert ("TPL002:models/gbdt.py:GBDTBooster._get_scan_fn.scan_fn"
+            ".body:jax.device_get#1") in fids, fids
+
+
+def test_pop_scan_iter_host_fetch_mutation_fails(tmp_path):
+    """A blocking per-pop device read in the hot scan driver (e.g.
+    re-fetching the pack slice per iteration) re-opens the dispatch
+    gap; the hot marker must surface it."""
+    anchor = "        self._push_guard_flags(it, p[\"flags\"][j])"
+    res = _lint_mutated(
+        "models/gbdt.py",
+        lambda src: src.replace(
+            anchor,
+            "        jax.device_get(self.score)\n" + anchor),
+        ["TPL002"], tmp_path)
+    fids = [f.fid for f in res.findings]
+    assert ("TPL002:models/gbdt.py:GBDTBooster._pop_scan_iter:"
+            "jax.device_get#1") in fids, fids
 
 
 # ---------------------------------------------------------------------

@@ -168,6 +168,38 @@ def test_jsonl_schema_one_valid_event_per_iteration(tmp_path):
     assert first["recompiles"]["delta"] >= 1
 
 
+def test_process_fault_log_pollution_is_isolated_a():
+    """First half of the order-independence regression (the
+    test_distributed_resilience -> test_jsonl_schema flake, ISSUE 11):
+    leave stray events in the PROCESS-LEVEL fault log exactly like the
+    in-process chaos tests do and rely on the conftest autouse fixture
+    to drain them after this test."""
+    from lightgbm_tpu.resilience.faults import record_fault_event
+    record_fault_event("collective_timeout", iteration=12,
+                       action="raise", detail="synthetic leak (test)")
+    record_fault_event("init_retry", action="retry",
+                       detail="synthetic leak (test)")
+
+
+def test_process_fault_log_pollution_is_isolated_b(tmp_path):
+    """Second half: the previous test's leaked process-level fault
+    events must NOT appear in this run's JSONL stream — without the
+    conftest isolation fixture the recorder drains them here and the
+    one-event-per-iteration schema breaks (reproduced at b344f30 with
+    test_distributed_resilience running first)."""
+    from lightgbm_tpu.resilience.faults import FAULT_EVENTS
+    assert not FAULT_EVENTS, (
+        "process-level fault log leaked across tests — the conftest "
+        "_isolate_process_fault_log fixture is gone or broken")
+    path = str(tmp_path / "isolated.jsonl")
+    rounds = 3
+    _small_train(tmp_path, callbacks=[cbm.telemetry(path)],
+                 rounds=rounds, valid=False)
+    lines = [ln for ln in open(path).read().splitlines() if ln]
+    events = [json.loads(ln) for ln in lines]
+    assert [e["event"] for e in events] == ["iteration"] * rounds
+
+
 def test_telemetry_records_fused_path_tree_stats(tmp_path):
     """No valid sets -> the fused/deferred path; tree stats must still
     be read (via the pending async copies, without flushing them)."""
