@@ -17,6 +17,15 @@ if __name__ == "__main__":
         from .resilience.elastic import main as launch_main
         raise SystemExit(launch_main(sys.argv[2:]))
 
+    # `pipeline` is the continuous train->publish->serve lifecycle
+    # driver (pipeline.py, docs/PIPELINE.md). Its supervisor loop,
+    # load generator and --help are jax-free like `launch` — jax only
+    # loads inside the spawned training workers and serve replicas
+    # (the hidden --train-worker mode re-enters here).
+    if len(sys.argv) > 1 and sys.argv[1] == "pipeline":
+        from .pipeline import main as pipeline_main
+        raise SystemExit(pipeline_main(sys.argv[2:]))
+
     # `serve` is the inference daemon (serve/daemon.py). Its argument
     # parse, --help and bad-model-path errors are jax-free (the serve
     # package __init__ is PEP-562 lazy); jax loads only once a model

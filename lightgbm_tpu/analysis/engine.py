@@ -26,12 +26,15 @@ __all__ = ["run_lint", "LintResult", "default_scope", "package_root",
 #: data/ for the ingestion pipeline's pass-1/pass-2 host collectives
 #: (TPL007) and jax-laziness, serve/ for the inference daemon's
 #: batcher/watcher thread contract (TPL006/TPL008) and its bucketed
-#: jit program (TPL003), and the per-iteration device-code modules at
-#: package root).
+#: jit program (TPL003), pipeline.py for the lifecycle supervisor's
+#: load-generator thread contract (TPL006/TPL008; the publisher rides
+#: the resilience/ scope), and the per-iteration device-code modules
+#: at package root).
 _SCOPE_DIRS = ("models/", "ops/", "parallel/", "resilience/", "obs/",
                "data/", "serve/")
 _SCOPE_FILES = ("engine.py", "ranking.py", "prediction.py",
-                "metrics.py", "objectives.py", "shap.py")
+                "metrics.py", "objectives.py", "shap.py",
+                "pipeline.py")
 
 
 def package_root() -> str:

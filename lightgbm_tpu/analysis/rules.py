@@ -489,10 +489,10 @@ class LockAcrossDispatch(Rule):
     one slow device batch."""
 
     id = "TPL006"
-    title = "lock held across jax dispatch in obs/, resilience/ " \
-            "or serve/"
+    title = "lock held across jax dispatch in obs/, resilience/, " \
+            "serve/ or pipeline.py"
 
-    _SCOPE_PREFIXES = ("obs/", "resilience/", "serve/")
+    _SCOPE_PREFIXES = ("obs/", "resilience/", "serve/", "pipeline")
     _LOCK_CALLS = {"Lock", "RLock", "Condition", "Semaphore"}
 
     def run(self, ctx: LintContext) -> Iterator[Finding]:

@@ -280,12 +280,15 @@ class ThreadSharedState(Rule):
     every spawn is a *fresh* thread, so two successive collectives
     already race on it. Scope includes ``serve/``: the inference
     daemon's batcher worker, hot-swap watcher and stats loop all
-    mutate state that submit()/stats() callers read concurrently."""
+    mutate state that submit()/stats() callers read concurrently —
+    and ``pipeline.py``, whose load-generator thread records outcome
+    stats the supervisor loop snapshots."""
 
     id = "TPL008"
     title = "thread-shared state mutated without a common lock"
 
-    _SCOPE_PREFIXES = ("obs/", "resilience/", "parallel/", "serve/")
+    _SCOPE_PREFIXES = ("obs/", "resilience/", "parallel/", "serve/",
+                       "pipeline")
 
     def run(self, ctx: LintContext) -> Iterator[Finding]:
         thread_side = thread_side_functions(ctx.graph)

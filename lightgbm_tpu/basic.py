@@ -1382,10 +1382,25 @@ class Booster:
 
     def _preload(self, base: "Booster") -> None:
         """Adopt an existing model's trees for continued training
-        (init_model semantics, reference engine.py/basic.py)."""
-        import copy as _copy
-        trees = [_copy.deepcopy(t) for t in base._models]
-        self._engine.preload_models(trees)
+        (init_model semantics, reference engine.py/basic.py).
+
+        The trees are adopted through a model-text round trip rather
+        than a deepcopy: a live Booster's trees carry ``threshold_bin``
+        indices in the bin space of the dataset they were GROWN
+        against, and continued training on FRESH data (the
+        warm-start retrain loop, docs/PIPELINE.md) bins this train set
+        with its own mappers — stale bin indices would silently
+        mis-route rows. Parsed trees carry ``threshold_bin = -1``, so
+        the binned traversal maps the real-valued thresholds onto the
+        current mappers (``_binned_node_arrays``), exactly like the
+        init_model-from-file and checkpoint-restore paths (whose
+        byte-exact resume proves the round trip lossless)."""
+        parsed = Booster(model_str=base.model_to_string())
+        self._engine.preload_models(parsed._trees)
+        # continued training adds num_boost_round NEW iterations on
+        # top of the adopted ones (reference: init_iteration +
+        # num_boost_round); the engine loop needs the offset
+        self._engine.init_iteration = int(self._engine.iter_)
 
     def add_valid(self, data: Dataset, name: str) -> "Booster":
         data.construct()
@@ -1717,7 +1732,16 @@ class Booster:
         """Refit leaf values on new data keeping tree structures
         (reference basic.py refit -> LGBM_BoosterRefit / GBDT::RefitTree:
         new_leaf = decay*old + (1-decay)*fit, trees processed in boosting
-        order so later trees see refreshed scores)."""
+        order so later trees see refreshed scores).
+
+        The warm-start edge of the continuous retrain loop
+        (docs/PIPELINE.md): fresh production data is rarely clean, so
+        per-tree gradients/hessians and the fitted leaf values run
+        through the same non-finite guard as training
+        (``nonfinite_policy``: raise | skip_tree — the tree keeps its
+        old leaf values | clamp), and the ``refit_nan@T`` chaos kind
+        (resilience/faults.py) poisons tree ``T``'s gradients to prove
+        it. Guard trips surface as ``refit_nan`` fault events."""
         if not self._models:
             raise LightGBMError("Cannot refit an empty model")
         if any(t.is_linear and t.leaf_coeff and any(
@@ -1747,6 +1771,10 @@ class Booster:
         score = np.zeros((K, n), np.float64)
         lam = cfg.lambda_l2
         shrink = cfg.learning_rate
+        from .resilience.faults import FaultPlan, append_fault_event
+        fault_plan = FaultPlan.from_env()
+        policy = cfg.nonfinite_policy
+        fault_log: List[Dict] = []
         for ti, tree in enumerate(new_bst._models):
             k = ti % K
             g, h = objective.grad_hess(
@@ -1757,15 +1785,38 @@ class Booster:
                 else np.asarray(g, np.float64).ravel()
             h = np.asarray(h, np.float64).reshape(K, n)[k] if K > 1 \
                 else np.asarray(h, np.float64).ravel()
+            if fault_plan.take("refit_nan", ti):
+                g = np.where(np.arange(n) % 7 == 0, np.nan, g)
             lv = leaves[:, ti]
             L = tree.num_leaves
             sg = np.bincount(lv, weights=g, minlength=L)
             sh = np.bincount(lv, weights=h, minlength=L)
             fit = -sg / (sh + lam)
             fit = fit * shrink
+            # non-finite guard (same policy surface as training): bad
+            # labels / poisoned gradients in the fresh data must not
+            # publish a NaN forest into the serve fleet
+            if not np.all(np.isfinite(fit)):
+                if policy == "raise":
+                    raise LightGBMError(
+                        f"refit: non-finite leaf values fitted for "
+                        f"tree {ti} (nonfinite_policy=raise)")
+                if policy == "skip_tree":
+                    append_fault_event(
+                        fault_log, "refit_nan", ti, "skip_tree",
+                        f"non-finite refit values for tree {ti}; "
+                        "keeping its existing leaf values")
+                    score[k] += tree.leaf_value[lv]
+                    continue
+                append_fault_event(
+                    fault_log, "refit_nan", ti, "clamp",
+                    f"non-finite refit values for tree {ti} clamped")
+                fit = np.nan_to_num(fit, nan=0.0,
+                                    posinf=1e30, neginf=-1e30)
             tree.leaf_value = decay_rate * tree.leaf_value \
                 + (1.0 - decay_rate) * fit
             score[k] += tree.leaf_value[lv]
+        new_bst._refit_fault_log = fault_log
         return new_bst
 
     def free_dataset(self) -> "Booster":

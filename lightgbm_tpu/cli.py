@@ -228,8 +228,9 @@ def _task_stats(argv: List[str]) -> int:
         print(f"[LightGBM-TPU] [Fatal] malformed telemetry in {path}: "
               f"{e}", file=sys.stderr)
         return 1
-    if summary["iterations"] == 0 and not summary.get("serve"):
-        print(f"no iteration or serve events in {path}",
+    if summary["iterations"] == 0 and not summary.get("serve") \
+            and not summary.get("publishes"):
+        print(f"no iteration, serve or publish events in {path}",
               file=sys.stderr)
         return 1
     print(render_stats_table(summary))

@@ -502,6 +502,35 @@ class Config:
     serve_stats_interval_sec: float = 10.0
     # seconds between polls of the hot-swap watch directory
     serve_watch_interval_sec: float = 1.0
+    # load shedding (docs/SERVING.md "Overload policy"): soft backlog
+    # threshold in pending rows — above it the batcher worker sheds
+    # its OLDEST queued requests with a typed {"shed": true} reply
+    # until the backlog is back under the threshold, so fresh arrivals
+    # keep bounded latency instead of every caller timing out
+    # together. 0 (default) disables; must stay below serve_queue_rows
+    # (the hard admission wall) to ever fire
+    serve_shed_queue_rows: int = 0
+    # per-request latency budget in milliseconds: a queued request
+    # that already waited longer is shed at dequeue time (its deadline
+    # is blown; serving it would only steal capacity from requests
+    # that can still meet theirs). 0 (default) disables
+    serve_shed_p99_ms: float = 0.0
+    # graceful-shutdown deadline in seconds: on SIGTERM or the
+    # protocol `shutdown` command the daemon stops accepting, drains
+    # already-accepted requests for up to this long, waits for the
+    # replies to reach the wire, and only then closes the socket — a
+    # supervised restart never drops an accepted request
+    serve_shutdown_grace_sec: float = 15.0
+
+    # ---- publish (resilience/publisher.py; docs/PIPELINE.md) ----
+    # retry budget for one atomic model publication into the serve
+    # watch directory (transient failures: full disk, slow rename,
+    # injected publish_torn chaos)
+    publish_retries: int = 5
+    # base of the jittered exponential backoff between publish
+    # retries (doubles per attempt, capped at 15 s, x[0.5, 1.5)
+    # jitter — the init_distributed retry shape)
+    publish_backoff_sec: float = 0.25
 
     # ---- convert ----
     convert_model_language: str = ""
@@ -678,6 +707,11 @@ class Config:
         "serve_queue_rows": (1, None),
         "serve_stats_interval_sec": (0.0, None, "gt"),
         "serve_watch_interval_sec": (0.0, None, "gt"),
+        "serve_shed_queue_rows": (0, None),
+        "serve_shed_p99_ms": (0.0, None),
+        "serve_shutdown_grace_sec": (0.0, None),
+        "publish_retries": (0, None),
+        "publish_backoff_sec": (0.0, None),
         "metric_freq": (1, None),
         "multi_error_top_k": (1, None),
     }
@@ -760,6 +794,13 @@ class Config:
                 "serve_min_bucket_rows must be <= serve_max_batch_rows "
                 f"({self.serve_min_bucket_rows} > "
                 f"{self.serve_max_batch_rows})")
+        if self.serve_shed_queue_rows \
+                and self.serve_shed_queue_rows >= self.serve_queue_rows:
+            raise ValueError(
+                "serve_shed_queue_rows (soft shed threshold) must stay "
+                "below serve_queue_rows (hard admission wall) to ever "
+                f"fire ({self.serve_shed_queue_rows} >= "
+                f"{self.serve_queue_rows})")
         for name, spec in self._BOUNDS.items():
             lo, hi = spec[0], spec[1]
             strict = len(spec) > 2 and spec[2] == "gt"

@@ -103,9 +103,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
     ``resilience.checkpoint`` callback — the newest valid snapshot is
     restored and training continues from its iteration toward
     ``num_boost_round`` *total* iterations (a directory without usable
-    snapshots trains from scratch). The ``LIGHTGBM_TPU_CHECKPOINT``
-    environment variable implies both ``resume_from`` and the
-    checkpoint callback itself; see docs/RESILIENCE.md.
+    snapshots trains from scratch). With ``init_model``,
+    ``num_boost_round`` counts the NEW iterations on top of the
+    adopted trees (reference ``init_iteration + num_boost_round``
+    semantics), and a snapshot written by such a run records the
+    offset — so resuming with the *identical* command finishes at the
+    same iteration the uninterrupted run would have. The
+    ``LIGHTGBM_TPU_CHECKPOINT`` environment variable implies both
+    ``resume_from`` and the checkpoint callback itself; see
+    docs/RESILIENCE.md.
     """
     params = resolve_params(params)
     # num_boost_round from params wins (alias resolution)
@@ -219,11 +225,25 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
     # still overrides inside deadline_seconds().
     watchdog.configure(cfg.collective_timeout_sec)
 
-    # resume continues toward num_boost_round TOTAL iterations (train
-    # 20 == train 10 then resume to 20); from-scratch runs keep the
-    # plain [0, num_boost_round) loop
-    begin_iteration = resumed_iteration
-    end_iteration = max(resumed_iteration, num_boost_round)
+    # iteration window (reference engine.py: range(init_iteration,
+    # init_iteration + num_boost_round)): continued training
+    # (init_model) adds num_boost_round NEW iterations on top of the
+    # adopted trees, with loop indices running on the ENGINE-ABSOLUTE
+    # iteration so callbacks/eval cadence and checkpoints agree with
+    # the engine's own iter_. Resume continues toward the SAME end the
+    # uninterrupted run had (train 20 == train 10 then resume to 20;
+    # the snapshot records the init offset, so a crashed warm-start
+    # retrain — the pipeline's rank_kill chaos, docs/PIPELINE.md —
+    # relaunched with the identical command still finishes at
+    # init + num_boost_round instead of stopping short).
+    init_iteration = 0
+    if booster._engine is not None:
+        init_iteration = int(getattr(booster._engine,
+                                     "init_iteration", 0))
+    begin_iteration = resumed_iteration if snap is not None \
+        else init_iteration
+    end_iteration = max(begin_iteration,
+                        init_iteration + num_boost_round)
     evaluation_result_list: List[Tuple] = []
     try:
         for i in range(begin_iteration, end_iteration):

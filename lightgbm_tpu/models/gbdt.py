@@ -296,6 +296,12 @@ class GBDTBooster:
         self._pending_dev: List[tuple] = []
         self._nl_async: List = []
         self.iter_ = 0
+        # iterations contributed by an adopted init_model (the
+        # reference's num_init_iteration): continued training adds
+        # num_boost_round iterations ON TOP of these, and a
+        # checkpoint-resumed continued run needs the offset to know
+        # its true end iteration (engine.py, docs/PIPELINE.md)
+        self.init_iteration = 0
         self.valid_sets: List[_ValidData] = []
         self._shrinkage = cfg.learning_rate
 

@@ -398,6 +398,8 @@ def summarize_events(path: str) -> dict:
     ingest: Optional[Dict[str, float]] = None
     serve: Optional[Dict[str, object]] = None
     serve_events = 0
+    publishes = 0
+    publish: Optional[Dict[str, object]] = None
     comm_bytes = 0
     comm_post_bytes = 0
     comm_last: Optional[Dict[str, object]] = None
@@ -437,6 +439,12 @@ def summarize_events(path: str) -> dict:
             # the summary (plus how many intervals were recorded)
             serve_events += 1
             serve = {k: v for k, v in ev.items() if k != "event"}
+            continue
+        if ev.get("event") == "publish":
+            # one line per atomic model publication
+            # (resilience/publisher.py; docs/PIPELINE.md)
+            publishes += 1
+            publish = {k: v for k, v in ev.items() if k != "event"}
             continue
         if ev.get("event") != "iteration":
             continue
@@ -481,6 +489,7 @@ def summarize_events(path: str) -> dict:
             "total_leaves": leaves, "total_split_gain": gain,
             "last_eval": last_eval, "faults": faults, "ingest": ingest,
             "serve": serve, "serve_events": serve_events,
+            "publishes": publishes, "publish": publish,
             "comm_bytes": comm_bytes,
             "comm_post_reduction_bytes": comm_post_bytes,
             "comm": comm_last,
@@ -517,8 +526,18 @@ def render_stats_table(summary: dict) -> str:
             f"{srv.get('qps', 0):g}, p50 "
             f"{'n/a' if p50 is None else '%g ms' % p50}, p99 "
             f"{'n/a' if p99 is None else '%g ms' % p99}, swaps "
-            f"{srv.get('swaps_total', 0)}, recompiles "
+            f"{srv.get('swaps_total', 0)}, shed "
+            f"{srv.get('shed_total', 0)}, recompiles "
             f"{rc.get('total', 0)}, model {srv.get('model', '?')}")
+    pub = summary.get("publish")
+    if pub:
+        sha = str(pub.get("sha256") or "?")
+        lines.append(
+            f"publish              : {summary.get('publishes', 0)} "
+            f"publication(s), last {pub.get('file', '?')} "
+            f"(gen {pub.get('generation', '?')}, "
+            f"train_auc {pub.get('train_auc', '?')}, "
+            f"sha256 {sha[:12]}…)")
     comm = summary.get("comm")
     if comm:
         cb = summary.get("comm_bytes", 0)

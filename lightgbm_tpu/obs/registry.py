@@ -18,7 +18,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Iterable, Optional, Tuple
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry"]
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "registry", "bump_counter"]
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -168,3 +169,13 @@ class MetricsRegistry:
 
 #: process-global default registry (the telemetry recorder feeds it)
 registry = MetricsRegistry()
+
+
+def bump_counter(name: str, value: float = 1, **labels) -> None:
+    """Best-effort counter bump for supervision/publishing paths that
+    must never fail on telemetry (elastic supervisor, model
+    publisher): any registry error is swallowed."""
+    try:
+        registry.counter(name, **labels).inc(value)
+    except Exception:
+        pass

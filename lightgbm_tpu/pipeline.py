@@ -1,0 +1,845 @@
+"""``python -m lightgbm_tpu pipeline``: the closed production loop.
+
+One supervised lifecycle joins every subsystem the ROADMAP grew
+(docs/PIPELINE.md):
+
+    ingest -> train -> publish -> serve -> (fresh data) -> retrain ...
+
+- **Generations**: each generation ingests a fresh (drifting) data
+  slice through the PR-7 chunk sources, warm-starts from the previous
+  published model (``--warm-start refit`` re-derives the existing
+  forest's leaf values from fresh gradients — the reference's
+  ``FitByExistingTree`` semantics — then appends; ``append`` continues
+  training via ``init_model``; ``none`` retrains from scratch), and
+  publishes the result atomically (resilience/publisher.py:
+  manifest-first, sha256-validated, retried with jittered backoff)
+  into the serve fleet's watch directory.
+- **Training runs supervised**: every generation trains under the
+  elastic supervisor (resilience/elastic.py) with a per-generation
+  checkpoint directory, so a ``rank_kill`` mid-retrain relaunches and
+  resumes instead of losing the generation.
+- **Serving runs supervised**: the replica fleet runs under
+  ``launch --health-port`` (per-rank restart + JSON ping health
+  checks); hot swaps ride the daemon's watch-dir poller, which
+  validates every managed artifact against its manifest and skips
+  torn publications with a ``swap_failure`` fault event.
+- **Traffic**: a built-in load generator drives the fleet for the
+  whole run and records client-side QPS / latency / shed / error
+  continuity into the pipeline's JSONL telemetry — the proof that
+  swaps, replica kills and torn publishes never broke the service.
+
+This module's CLI dispatch, the supervisor loop and the load
+generator are jax-free (like ``lint`` / ``launch``): jax loads only
+inside the spawned training workers and serve replicas. The hidden
+``--train-worker`` mode is that worker entry point.
+
+Threading contract (tpulint TPL006/TPL008 over pipeline.py): the load
+generator's stats are shared between its worker thread and the
+supervisor loop — every mutable field is touched only under
+``self._lock``, and the blocking socket I/O runs outside it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .utils.log import log_info, log_warning
+
+__all__ = ["main", "build_parser", "LoadGenerator", "replica_stats"]
+
+#: fault kinds routed to the serve fleet's environment; everything
+#: else goes to the training workers (docs/PIPELINE.md chaos matrix)
+_SERVE_FAULT_KINDS = ("serve_kill",)
+
+
+# ---------------------------------------------------------------------
+# small jax-free protocol clients (supervisor side)
+# ---------------------------------------------------------------------
+
+def _rpc(port: int, obj: Dict[str, Any], timeout: float = 10.0,
+         host: str = "127.0.0.1") -> Optional[Dict[str, Any]]:
+    """One request -> one reply against a serve replica; None on any
+    transport/parse failure (the supervisor polls, it never crashes).
+    One implementation, shared with the fleet supervisor's health
+    probe."""
+    from .resilience.elastic import replica_rpc
+    return replica_rpc(port, obj, timeout=timeout, host=host)
+
+
+def replica_stats(port: int, timeout: float = 10.0
+                  ) -> Optional[Dict[str, Any]]:
+    return _rpc(port, {"cmd": "stats"}, timeout=timeout)
+
+
+def _split_faults(spec: str) -> Tuple[str, str]:
+    """Route a LIGHTGBM_TPU_FAULT_INJECT spec to its side of the
+    lifecycle: (train_spec, serve_spec)."""
+    train_toks: List[str] = []
+    serve_toks: List[str] = []
+    for tok in (spec or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        kind = tok.split("@", 1)[0].strip()
+        (serve_toks if kind in _SERVE_FAULT_KINDS
+         else train_toks).append(tok)
+    return ",".join(train_toks), ",".join(serve_toks)
+
+
+# ---------------------------------------------------------------------
+# telemetry writer (supervisor side, shared by the loadgen thread)
+# ---------------------------------------------------------------------
+
+class _EventLog:
+    """Append-only JSONL writer shared between the supervisor loop and
+    the load-generator thread; the file handle is the shared state,
+    one lock orders the writes."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+        self._file = open(path, "a", encoding="utf-8")
+
+    def write(self, event: Dict[str, Any]) -> None:
+        line = json.dumps(event) + "\n"
+        with self._lock:
+            if self._file is None:
+                return
+            try:
+                self._file.write(line)
+                self._file.flush()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        with self._lock:
+            fh, self._file = self._file, None
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------
+# load generator (supervisor side; jax-free)
+# ---------------------------------------------------------------------
+
+class LoadGenerator:
+    """Constant-rate request driver for the serve fleet.
+
+    One worker thread round-robins the replica ports, keeps one
+    persistent connection per replica (reconnecting on failure), and
+    classifies every outcome: ``ok``, ``shed`` (typed overload reply),
+    ``overloaded`` (hard backpressure), ``error`` (error reply),
+    ``conn`` (connect/reset — a killed replica), ``timeout`` (a reply
+    that never came: the one class that would mean a silently dropped
+    accepted request). Stats are read by the supervisor thread, so
+    every mutable field lives under ``self._lock``; all socket I/O
+    happens outside it (TPL006/TPL008).
+    """
+
+    def __init__(self, ports: List[int], n_features: int,
+                 rate_per_sec: float = 20.0, rows_per_request: int = 4,
+                 reply_timeout: float = 30.0,
+                 event_log: Optional[_EventLog] = None,
+                 stats_interval: float = 1.0):
+        self.ports = list(ports)
+        self.n_features = int(n_features)
+        self.rate = max(0.1, float(rate_per_sec))
+        self.rows = max(1, int(rows_per_request))
+        self.reply_timeout = float(reply_timeout)
+        self.event_log = event_log
+        self.stats_interval = max(0.1, float(stats_interval))
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        # ---- guarded by self._lock ----
+        self._counts = {"attempts": 0, "ok": 0, "shed": 0,
+                        "overloaded": 0, "error": 0, "conn": 0,
+                        "timeout": 0}
+        self._latencies: deque = deque(maxlen=4096)
+        self._last_ok: Optional[float] = None
+        self._max_ok_gap = 0.0
+        self._last_model: Optional[str] = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name="lightgbm-tpu-pipeline-loadgen")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self, timeout: float = 30.0) -> None:
+        self._stop.set()
+        self._thread.join(timeout=timeout)
+
+    def _note(self, outcome: str, latency: Optional[float] = None,
+              model: Optional[str] = None,
+              want_stats: bool = False) -> Optional[Dict[str, Any]]:
+        """Record one outcome; with ``want_stats`` also returns the
+        event-ready stats view, so the worker thread never has to read
+        the shared fields outside this one locked section
+        (``snapshot()`` below is the supervisor-thread reader of the
+        same state). The view — a sort of the latency window — is only
+        built on the event cadence, not per request."""
+        now = time.monotonic()
+        with self._lock:
+            self._counts["attempts"] += 1
+            self._counts[outcome] += 1
+            if latency is not None:
+                self._latencies.append(latency)
+            if outcome == "ok":
+                if self._last_ok is not None:
+                    self._max_ok_gap = max(self._max_ok_gap,
+                                           now - self._last_ok)
+                self._last_ok = now
+                if model is not None:
+                    self._last_model = model
+            if not want_stats:
+                return None
+            counts = dict(self._counts)
+            lat = sorted(self._latencies)
+            gap = self._max_ok_gap
+            last_ok = self._last_ok
+            model_now = self._last_model
+        return self._format(counts, lat, gap, last_ok, model_now)
+
+    @staticmethod
+    def _format(counts: Dict[str, int], lat: List[float], gap: float,
+                last_ok: Optional[float],
+                model: Optional[str]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {**counts, "max_ok_gap_s": round(gap, 3),
+                               "model": model}
+        if last_ok is not None:
+            out["since_last_ok_s"] = round(
+                time.monotonic() - last_ok, 3)
+        if lat:
+            out["p50_ms"] = round(lat[len(lat) // 2] * 1e3, 3)
+            out["p99_ms"] = round(
+                lat[min(len(lat) - 1, (len(lat) * 99) // 100)] * 1e3, 3)
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Supervisor-side stats view (the summary + swap gating)."""
+        with self._lock:
+            counts = dict(self._counts)
+            lat = sorted(self._latencies)
+            gap = self._max_ok_gap
+            last_ok = self._last_ok
+            model = self._last_model
+        return self._format(counts, lat, gap, last_ok, model)
+
+    # -- worker thread -------------------------------------------------
+    def _run(self) -> None:
+        import random as _random
+        rng = _random.Random(1234)
+        conns: Dict[int, Any] = {}
+        period = 1.0 / self.rate
+        next_stats = time.monotonic() + self.stats_interval
+        i = 0
+        while not self._stop.wait(period):
+            port = self.ports[i % len(self.ports)]
+            i += 1
+            rows = [[rng.uniform(-2.0, 2.0)
+                     for _ in range(self.n_features)]
+                    for _ in range(self.rows)]
+            t0 = time.monotonic()
+            want = self.event_log is not None and t0 >= next_stats
+            try:
+                fh = conns.get(port)
+                if fh is None:
+                    s = socket.create_connection(
+                        ("127.0.0.1", port), timeout=5.0)
+                    s.settimeout(self.reply_timeout)
+                    fh = s.makefile("rw", encoding="utf-8")
+                    conns[port] = fh
+                fh.write(json.dumps({"rows": rows}) + "\n")
+                fh.flush()
+                line = fh.readline()
+                if not line:
+                    raise OSError("connection closed by replica")
+                reply = json.loads(line)
+            except socket.timeout:
+                conns.pop(port, None)
+                stats = self._note("timeout", want_stats=want)
+            except (OSError, ValueError):
+                conns.pop(port, None)
+                stats = self._note("conn", want_stats=want)
+            else:
+                dt = time.monotonic() - t0
+                if reply.get("shed"):
+                    stats = self._note("shed", want_stats=want)
+                elif reply.get("overloaded"):
+                    stats = self._note("overloaded", want_stats=want)
+                elif "error" in reply:
+                    stats = self._note("error", want_stats=want)
+                else:
+                    stats = self._note("ok", latency=dt,
+                                       model=reply.get("model"),
+                                       want_stats=want)
+            if stats is not None:
+                next_stats = time.monotonic() + self.stats_interval
+                self.event_log.write(
+                    {"event": "client", "time": time.time(), **stats})
+        for fh in conns.values():
+            try:
+                fh.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------
+
+_HELP_EPILOG = """\
+The pipeline drives ingest -> train -> publish -> serve generations
+under supervision (docs/PIPELINE.md): training generations run under
+the elastic supervisor with per-generation checkpoint auto-resume,
+models publish atomically (manifest-first, sha256-validated, retried
+with backoff) into the serve fleet's watch directory, and the fleet
+runs under `launch --health-port` with per-replica restarts. Chaos
+rides LIGHTGBM_TPU_FAULT_INJECT / --fault-inject: serve_kill@N goes to
+the fleet, everything else (rank_kill@I, publish_torn@G, refit_nan@T,
+nan_grad@I, ...) to the training workers.
+
+exit codes:
+  0  every generation trained, published, and was confirmed serving
+  1  a generation failed, publication failed, or the fleet never
+     confirmed the final model
+  2  bad command line
+"""
+
+
+def build_parser() -> argparse.ArgumentParser:
+    from .config import Config
+    p = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu pipeline",
+        description="Continuous train -> publish -> serve lifecycle "
+                    "under supervision: warm-start retraining on "
+                    "fresh data, atomic manifest-validated "
+                    "publication, health-checked serve fleet, "
+                    "built-in load generator.",
+        epilog=_HELP_EPILOG,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--workdir", required=True,
+                   help="working directory (publish/, checkpoints/, "
+                        "telemetry/, logs/ are created inside)")
+    p.add_argument("--generations", type=int, default=3,
+                   help="retrain generations to run (default 3)")
+    p.add_argument("--rounds", type=int, default=10,
+                   help="boosting iterations added per generation")
+    p.add_argument("--rows", type=int, default=4000,
+                   help="rows of fresh data per generation")
+    p.add_argument("--features", type=int, default=16,
+                   help="feature count of the synthetic stream")
+    p.add_argument("--num-leaves", type=int, default=15)
+    p.add_argument("--warm-start",
+                   choices=("append", "refit", "none"),
+                   default="append",
+                   help="how generation g>0 uses generation g-1's "
+                        "published model: append = continued training "
+                        "(init_model); refit = re-derive the existing "
+                        "forest's leaf values from fresh gradients "
+                        "(FitByExistingTree semantics) then append; "
+                        "none = from scratch")
+    p.add_argument("--refit-decay", type=float, default=0.9,
+                   help="refit decay rate (new_leaf = decay*old + "
+                        "(1-decay)*fit)")
+    p.add_argument("--ingest-chunk-rows", type=int, default=512,
+                   help="streaming ingest chunk size (data/, PR 7 "
+                        "chunk sources)")
+    p.add_argument("--param", action="append", default=[],
+                   metavar="K=V",
+                   help="extra training parameter (repeatable), e.g. "
+                        "--param nonfinite_policy=skip_tree")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve replicas under the health-checked "
+                        "fleet supervisor")
+    p.add_argument("--port", type=int, default=0,
+                   help="base serve port (default: a free port)")
+    p.add_argument("--request-rate", type=float, default=20.0,
+                   help="load-generator requests per second (0 "
+                        "disables the load generator)")
+    p.add_argument("--request-rows", type=int, default=4,
+                   help="rows per generated request")
+    p.add_argument("--max-restarts", type=int, default=6,
+                   help="restart budget for each supervised side")
+    p.add_argument("--max-restarts-per-window", type=int, default=0,
+                   help="sliding-window restart cap (0 = disabled)")
+    p.add_argument("--restart-window", type=float, default=300.0)
+    p.add_argument("--grace", type=float, default=5.0,
+                   help="teardown grace seconds")
+    p.add_argument("--health-interval", type=float, default=1.0)
+    p.add_argument("--health-grace", type=float, default=90.0,
+                   help="startup window before a replica is pinged")
+    p.add_argument("--swap-timeout", type=float, default=180.0,
+                   help="seconds to wait for the fleet to confirm a "
+                        "published model before failing")
+    p.add_argument("--shed-queue-rows", type=int,
+                   default=Config.serve_shed_queue_rows)
+    p.add_argument("--shed-p99-ms", type=float,
+                   default=Config.serve_shed_p99_ms)
+    p.add_argument("--fault-inject", default=None,
+                   help="chaos spec (default: "
+                        "$LIGHTGBM_TPU_FAULT_INJECT)")
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--keep-fleet", action="store_true",
+                   help="leave the serve fleet running on exit "
+                        "(default: graceful shutdown)")
+    # hidden: the jax-side training worker entry point (one
+    # generation), spawned under the elastic supervisor
+    p.add_argument("--train-worker", type=int, default=None,
+                   metavar="GEN", help=argparse.SUPPRESS)
+    return p
+
+
+def _parse_params(pairs: List[str]) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise ValueError(f"--param expects K=V, got {pair!r}")
+        k, v = pair.split("=", 1)
+        out[k.strip()] = v.strip()
+    return out
+
+
+# ---------------------------------------------------------------------
+# the training worker (jax side; one generation)
+# ---------------------------------------------------------------------
+
+def _gen_data(seed: int, gen: int, rows: int, features: int):
+    """Drifting synthetic binary stream: generation g's data comes
+    from a slowly rotating weight vector, so retraining on fresh data
+    genuinely moves the model (and a stale model measurably decays)."""
+    import numpy as np
+    rng = np.random.RandomState(seed * 1000 + gen)
+    w = np.sin(np.arange(features) * 0.7 + 0.35 * gen)
+    X = rng.randn(rows, features).astype(np.float64)
+    logits = X @ w + 0.5 * rng.randn(rows)
+    y = (logits > 0).astype(np.float64)
+    return X, y
+
+
+def _auc(y, scores) -> float:
+    """Rank-based AUC without sklearn."""
+    import numpy as np
+    y = np.asarray(y).ravel()
+    s = np.asarray(scores, np.float64).ravel()
+    order = np.argsort(s, kind="mergesort")
+    ranks = np.empty(len(s), np.float64)
+    ranks[order] = np.arange(1, len(s) + 1)
+    # average ties
+    sorted_s = s[order]
+    i = 0
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and sorted_s[j + 1] == sorted_s[i]:
+            j += 1
+        if j > i:
+            ranks[order[i:j + 1]] = 0.5 * (i + j) + 1
+        i = j + 1
+    npos = float((y > 0).sum())
+    nneg = float(len(y) - npos)
+    if npos == 0 or nneg == 0:
+        return 0.5
+    return float((ranks[y > 0].sum() - npos * (npos + 1) / 2)
+                 / (npos * nneg))
+
+
+def _train_worker(args) -> int:
+    """One supervised retrain generation: ingest fresh chunked data,
+    warm-start from the newest published model, train, publish
+    atomically. Runs under the elastic supervisor with
+    LIGHTGBM_TPU_CHECKPOINT pointing at the generation's checkpoint
+    directory, so a mid-train kill relaunches this exact function and
+    resumes."""
+    gen = int(args.train_worker)
+    import numpy as np
+
+    import lightgbm_tpu as lgb
+    from .config import Config
+    from .data.sources import GeneratorChunkSource
+    from .resilience.publisher import latest_manifest, publish_model
+
+    workdir = os.path.abspath(args.workdir)
+    publish_dir = os.path.join(workdir, "publish")
+    os.makedirs(publish_dir, exist_ok=True)
+    X, y = _gen_data(args.seed, gen, args.rows, args.features)
+    chunk = max(64, int(args.ingest_chunk_rows))
+
+    def factory():
+        for lo in range(0, len(y), chunk):
+            yield X[lo:lo + chunk], y[lo:lo + chunk]
+
+    source = GeneratorChunkSource(factory, num_rows=len(y),
+                                  num_features=args.features)
+    params: Dict[str, Any] = {
+        "objective": "binary", "num_leaves": int(args.num_leaves),
+        "verbosity": -1, "ingest_chunk_rows": chunk,
+        **_parse_params(args.param)}
+    ds = lgb.Dataset(source, params=params)
+
+    init_model = None
+    refit_auc = None
+    prev = None if (gen == 0 or args.warm_start == "none") \
+        else latest_manifest(publish_dir)
+    if prev is not None:
+        prev_path, prev_manifest = prev
+        log_info(f"pipeline[g{gen}]: warm-starting from "
+                 f"{prev_path} (generation "
+                 f"{prev_manifest.get('generation')})")
+        base = lgb.Booster(model_file=prev_path)
+        if args.warm_start == "refit":
+            # FitByExistingTree: same structures, leaf values
+            # re-derived from THIS generation's gradients
+            base = base.refit(X, y, decay_rate=args.refit_decay)
+            refit_auc = _auc(y, base.predict(X))
+            log_info(f"pipeline[g{gen}]: refit AUC on fresh data "
+                     f"{refit_auc:.4f}")
+        init_model = base
+    bst = lgb.train(params, ds, num_boost_round=int(args.rounds),
+                    init_model=init_model)
+    train_auc = _auc(y, bst.predict(X))
+    digest = getattr(ds, "_data_digest", None)
+    cfg = Config.from_params(params)
+    manifest = publish_model(
+        bst, publish_dir, f"model_g{gen:04d}.txt",
+        metadata={
+            "generation": gen,
+            "train_auc": round(train_auc, 6),
+            "refit_auc": None if refit_auc is None
+            else round(refit_auc, 6),
+            "data_digest": digest,
+            "rounds": int(args.rounds),
+            "num_trees": bst.num_trees(),
+            "warm_start": args.warm_start if gen else "none",
+        },
+        retries=cfg.publish_retries,
+        backoff_base_sec=cfg.publish_backoff_sec,
+        fault_iteration=gen)
+    # one {"event": "publish"} JSONL line rides the generation's
+    # training telemetry (the recorder closed when train() returned;
+    # appends to the same stream keep one post-mortem timeline)
+    telem = os.environ.get("LIGHTGBM_TPU_TELEMETRY")
+    if telem:
+        try:
+            with open(telem, "a", encoding="utf-8") as fh:
+                fh.write(json.dumps(
+                    {"event": "publish", **manifest}) + "\n")
+        except OSError:
+            pass
+    print(json.dumps({"event": "published", "generation": gen,
+                      "file": manifest["file"],
+                      "sha256": manifest["sha256"],
+                      "train_auc": manifest["train_auc"]}),
+          flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------
+# the supervisor (jax-free)
+# ---------------------------------------------------------------------
+
+def _worker_cmd(args, gen: int) -> List[str]:
+    cmd = [sys.executable, "-m", "lightgbm_tpu", "pipeline",
+           "--workdir", args.workdir, "--train-worker", str(gen),
+           "--rounds", str(args.rounds), "--rows", str(args.rows),
+           "--features", str(args.features),
+           "--num-leaves", str(args.num_leaves),
+           "--warm-start", args.warm_start,
+           "--refit-decay", str(args.refit_decay),
+           "--ingest-chunk-rows", str(args.ingest_chunk_rows),
+           "--seed", str(args.seed)]
+    for pair in args.param:
+        cmd += ["--param", pair]
+    return cmd
+
+
+def _train_generation(args, gen: int, dirs: Dict[str, str],
+                      train_faults: str, events: _EventLog) -> int:
+    """One generation under the elastic supervisor (in-process call —
+    elastic.supervise is jax-free)."""
+    from .resilience.elastic import supervise
+    env = dict(os.environ)
+    env["LIGHTGBM_TPU_CHECKPOINT"] = os.path.join(
+        dirs["checkpoints"], f"g{gen:04d}")
+    env["LIGHTGBM_TPU_TELEMETRY"] = os.path.join(
+        dirs["telemetry"], f"train_g{gen:04d}.jsonl")
+    if train_faults:
+        env["LIGHTGBM_TPU_FAULT_INJECT"] = train_faults
+    else:
+        env.pop("LIGHTGBM_TPU_FAULT_INJECT", None)
+    events.write({"event": "pipeline", "phase": "train_start",
+                  "generation": gen, "time": time.time()})
+    rc = supervise(
+        1, _worker_cmd(args, gen), max_restarts=args.max_restarts,
+        # per-generation log dir: the fleet supervisor writes the
+        # same elastic_g*_rank*.log names into ITS dir
+        log_dir=os.path.join(dirs["logs"], f"train_g{gen:04d}"),
+        grace=args.grace, env=env,
+        max_restarts_per_window=args.max_restarts_per_window,
+        restart_window_sec=args.restart_window)
+    events.write({"event": "pipeline", "phase": "train_done",
+                  "generation": gen, "rc": rc, "time": time.time()})
+    return rc
+
+
+def _start_fleet(args, dirs: Dict[str, str], base_port: int,
+                 serve_faults: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    if serve_faults:
+        env["LIGHTGBM_TPU_FAULT_INJECT"] = serve_faults
+    else:
+        env.pop("LIGHTGBM_TPU_FAULT_INJECT", None)
+    env["LIGHTGBM_TPU_TELEMETRY"] = os.path.join(
+        dirs["telemetry"], "serve.jsonl")
+    cmd = [sys.executable, "-m", "lightgbm_tpu", "launch",
+           str(args.replicas),
+           "--max-restarts", str(args.max_restarts),
+           "--max-restarts-per-window",
+           str(args.max_restarts_per_window),
+           "--restart-window", str(args.restart_window),
+           "--health-port", str(base_port),
+           "--health-interval", str(args.health_interval),
+           "--health-grace", str(args.health_grace),
+           "--grace", str(args.grace),
+           "--log-dir", os.path.join(dirs["logs"], "fleet"), "--",
+           sys.executable, "-m", "lightgbm_tpu", "serve",
+           dirs["publish"],
+           "--port", str(base_port),
+           "--watch-dir", dirs["publish"],
+           "--watch-interval", "0.25",
+           "--stats-interval", "1.0",
+           "--shed-queue-rows", str(args.shed_queue_rows),
+           "--shed-p99-ms", str(args.shed_p99_ms),
+           "--grace", str(args.grace)]
+    log_path = os.path.join(dirs["logs"], "fleet_supervisor.log")
+    log_file = open(log_path, "ab")
+    try:
+        proc = subprocess.Popen(cmd, env=env, stdout=log_file,
+                                stderr=subprocess.STDOUT,
+                                start_new_session=True)
+    finally:
+        log_file.close()
+    return proc
+
+
+def _wait_fleet_ready(ports: List[int], timeout: float) -> bool:
+    from .resilience.elastic import replica_ping
+    deadline = time.monotonic() + timeout
+    pending = set(ports)
+    while pending and time.monotonic() < deadline:
+        for port in sorted(pending):
+            if replica_ping(port, timeout=2.0):
+                pending.discard(port)
+        if pending:
+            time.sleep(0.5)
+    return not pending
+
+
+def _confirm_swap(ports: List[int], want_sha: str,
+                  timeout: float) -> bool:
+    """Every replica reports a manifest-validated swap to the
+    publication identified by ``want_sha`` (replicas may briefly
+    disagree mid-rollout — or be mid-restart under chaos)."""
+    deadline = time.monotonic() + timeout
+    pending = set(ports)
+    while pending and time.monotonic() < deadline:
+        for port in sorted(pending):
+            st = replica_stats(port, timeout=5.0)
+            manifest = (st or {}).get("manifest") or {}
+            if manifest.get("sha256") == want_sha:
+                pending.discard(port)
+        if pending:
+            time.sleep(0.5)
+    return not pending
+
+
+def _shutdown_fleet(fleet: subprocess.Popen, ports: List[int],
+                    grace: float) -> None:
+    """Graceful: ask every replica to drain and exit 0, so the fleet
+    supervisor sees a clean fleet and exits 0 itself."""
+    for port in ports:
+        _rpc(port, {"cmd": "shutdown"}, timeout=5.0)
+    try:
+        fleet.wait(timeout=max(30.0, 2 * grace))
+        return
+    except subprocess.TimeoutExpired:
+        pass
+    from .resilience.elastic import _kill_group
+    _kill_group(fleet)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    try:
+        args = build_parser().parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+    if args.train_worker is not None:
+        # jax side: one supervised retrain generation
+        return _train_worker(args)
+
+    workdir = os.path.abspath(args.workdir)
+    dirs = {name: os.path.join(workdir, name)
+            for name in ("publish", "checkpoints", "telemetry",
+                         "logs")}
+    for d in dirs.values():
+        os.makedirs(d, exist_ok=True)
+    if args.generations < 1 or args.replicas < 1:
+        print("pipeline: --generations and --replicas must be >= 1",
+              file=sys.stderr)
+        return 2
+    fault_spec = args.fault_inject \
+        if args.fault_inject is not None \
+        else os.environ.get("LIGHTGBM_TPU_FAULT_INJECT", "")
+    train_faults, serve_faults = _split_faults(fault_spec)
+
+    from .resilience.elastic import _free_port
+    from .resilience.publisher import latest_manifest
+    base_port = args.port or _free_port()
+    ports = [base_port + r for r in range(args.replicas)]
+    events = _EventLog(os.path.join(dirs["telemetry"],
+                                    "pipeline.jsonl"))
+    events.write({"event": "pipeline", "phase": "start",
+                  "generations": args.generations,
+                  "replicas": args.replicas, "ports": ports,
+                  "warm_start": args.warm_start,
+                  "fault_inject": fault_spec, "time": time.time()})
+    fleet: Optional[subprocess.Popen] = None
+    loadgen: Optional[LoadGenerator] = None
+    failures: List[str] = []
+    swaps_confirmed = 0
+    published: List[Dict[str, Any]] = []
+    try:
+        # ---- generation 0: bootstrap model, then bring up the fleet
+        rc = _train_generation(args, 0, dirs, train_faults, events)
+        if rc != 0:
+            failures.append(f"generation 0 training failed (exit {rc})")
+            return _finish(args, events, failures, published,
+                           swaps_confirmed, None, loadgen)
+        first = latest_manifest(dirs["publish"])
+        if first is None:
+            failures.append("generation 0 published nothing usable")
+            return _finish(args, events, failures, published,
+                           swaps_confirmed, None, loadgen)
+        published.append(first[1])
+        fleet = _start_fleet(args, dirs, base_port, serve_faults)
+        if not _wait_fleet_ready(ports, timeout=args.swap_timeout):
+            failures.append(
+                f"serve fleet never became ready on ports {ports}")
+            return _finish(args, events, failures, published,
+                           swaps_confirmed, None, loadgen)
+        events.write({"event": "pipeline", "phase": "fleet_ready",
+                      "ports": ports, "time": time.time()})
+        if args.request_rate > 0:
+            loadgen = LoadGenerator(
+                ports, args.features, rate_per_sec=args.request_rate,
+                rows_per_request=args.request_rows,
+                event_log=events)
+            loadgen.start()
+        # the bootstrap model was loaded at startup, not hot-swapped:
+        # confirm the fleet serves it before retraining begins
+        if not _confirm_swap(ports, first[1]["sha256"],
+                             timeout=args.swap_timeout):
+            # startup path reports no manifest (the daemon loaded the
+            # file directly): fall back to source-path confirmation
+            ok = all((replica_stats(p, timeout=5.0) or {})
+                     .get("model_source") == first[0] for p in ports)
+            if not ok:
+                failures.append(
+                    "fleet did not confirm the bootstrap model")
+
+        # ---- retrain generations
+        for gen in range(1, args.generations):
+            rc = _train_generation(args, gen, dirs, train_faults,
+                                   events)
+            if rc != 0:
+                failures.append(
+                    f"generation {gen} training failed (exit {rc})")
+                break
+            latest = latest_manifest(dirs["publish"])
+            if latest is None or latest[1].get("generation") != gen:
+                failures.append(
+                    f"generation {gen} publication missing/invalid")
+                break
+            published.append(latest[1])
+            if _confirm_swap(ports, latest[1]["sha256"],
+                             timeout=args.swap_timeout):
+                swaps_confirmed += 1
+                events.write({"event": "pipeline",
+                              "phase": "swap_confirmed",
+                              "generation": gen,
+                              "sha256": latest[1]["sha256"],
+                              "time": time.time()})
+            else:
+                failures.append(
+                    f"fleet never confirmed generation {gen}'s "
+                    "publication within the swap timeout")
+                break
+        return _finish(args, events, failures, published,
+                       swaps_confirmed, ports, loadgen)
+    finally:
+        if loadgen is not None:
+            loadgen.stop()
+        if fleet is not None and not args.keep_fleet:
+            _shutdown_fleet(fleet, ports, args.grace)
+        elif fleet is not None:
+            log_info(f"pipeline: fleet left running on ports {ports} "
+                     "(--keep-fleet)")
+        events.close()
+
+
+def _finish(args, events: _EventLog, failures: List[str],
+            published: List[Dict[str, Any]], swaps_confirmed: int,
+            ports: Optional[List[int]],
+            loadgen: Optional[LoadGenerator]) -> int:
+    client = None if loadgen is None else loadgen.snapshot()
+    summary: Dict[str, Any] = {
+        "event": "pipeline_summary",
+        "generations_requested": args.generations,
+        "generations_published": len(published),
+        "swaps_confirmed": swaps_confirmed,
+        "last_published_sha256":
+            published[-1]["sha256"] if published else None,
+        "last_published_generation":
+            published[-1].get("generation") if published else None,
+        "train_auc_by_generation":
+            [m.get("train_auc") for m in published],
+        "failures": failures,
+        "time": time.time(),
+    }
+    if ports:
+        fleet_stats = [replica_stats(p, timeout=5.0) for p in ports]
+        summary["fleet"] = [
+            None if st is None else
+            {"model": st.get("model"),
+             "model_source": st.get("model_source"),
+             "manifest_sha256":
+                 (st.get("manifest") or {}).get("sha256"),
+             "requests_total": st.get("requests_total"),
+             "shed_total": st.get("shed_total"),
+             "swap_failures": st.get("swap_failures"),
+             "swaps_total": st.get("swaps_total")}
+            for st in fleet_stats]
+    summary["client"] = client
+    events.write(summary)
+    print(json.dumps(summary), flush=True)
+    if failures:
+        for f in failures:
+            log_warning(f"pipeline: FAILED: {f}")
+        return 1
+    log_info(f"pipeline: {len(published)} generation(s) trained, "
+             f"published and served; last model "
+             f"{summary['last_published_sha256'][:12]}…")
+    return 0

@@ -27,10 +27,16 @@ actually hit.
 - ``init_distributed`` retries its coordinator handshake with
   jittered exponential backoff (parallel/distributed.py) —
   ``init_retries`` / ``init_backoff_seconds`` registry counters.
+- :mod:`~lightgbm_tpu.resilience.publisher` — atomic, manifest-first
+  model publication into the serve daemon's watch directory with
+  jittered retry/backoff: the train -> serve handoff of the
+  continuous lifecycle (``python -m lightgbm_tpu pipeline``,
+  docs/PIPELINE.md).
 - :mod:`~lightgbm_tpu.resilience.faults` — the deterministic
   ``LIGHTGBM_TPU_FAULT_INJECT`` harness the tests drive all of the
   above with (including the distributed kinds ``rank_kill`` /
-  ``stall_rank`` / ``init_refuse``).
+  ``stall_rank`` / ``init_refuse`` and the lifecycle kinds
+  ``publish_torn`` / ``serve_kill`` / ``refit_nan``).
 
 Every fault surfaces as a ``{"event": "fault", ...}`` line in the
 telemetry JSONL stream (docs/OBSERVABILITY.md) and a
@@ -45,6 +51,8 @@ from .checkpoint import (Checkpoint, CheckpointError, checkpoint,
 from .faults import (FaultPlan, InjectedInitRefused,
                      InjectedResourceExhausted, is_resource_exhausted,
                      record_fault_event)
+from .publisher import (PublishError, latest_manifest, load_manifest,
+                        manifest_path, publish_model, validate_artifact)
 
 __all__ = [
     "checkpoint", "Checkpoint", "CheckpointError", "snapshot_path",
@@ -52,4 +60,6 @@ __all__ = [
     "list_snapshots", "restore_booster",
     "FaultPlan", "InjectedResourceExhausted", "InjectedInitRefused",
     "is_resource_exhausted", "record_fault_event", "watchdog",
+    "PublishError", "publish_model", "manifest_path", "load_manifest",
+    "validate_artifact", "latest_manifest",
 ]

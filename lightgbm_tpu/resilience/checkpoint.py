@@ -141,6 +141,10 @@ def write_snapshot(directory: str, booster, keep: int = 3,
         "iteration": iteration,
         "num_trees": len(booster._models),
         "num_model_per_iteration": int(eng.K),
+        # init_model offset of a continued-training run: resume must
+        # finish at init + num_boost_round, not num_boost_round
+        # (engine.py iteration window; docs/PIPELINE.md warm start)
+        "num_init_iteration": int(getattr(eng, "init_iteration", 0)),
         "best_iteration": int(booster.best_iteration),
         "best_score": {str(d): {str(m): float(v)
                                 for m, v in sub.items()}
@@ -378,6 +382,7 @@ def restore_booster(booster, snap: Dict[str, Any]) -> int:
                 f"checkpoint {snap.get('path')}: re-placed score "
                 f"shards differ from the saved ones at {bad} — the "
                 "device placement corrupted the score matrix")
+    eng.init_iteration = int(snap.get("num_init_iteration", 0))
     eng._resume_stalled = bool(snap.get("stalled", False))
     eng._tree_weights = [float(w) for w in snap.get("tree_weights", [])] \
         or [1.0] * len(trees)

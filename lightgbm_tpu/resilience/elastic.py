@@ -21,11 +21,36 @@ snapshot, so the restarted run converges to the same model an
 uninterrupted run produces (docs/RESILIENCE.md "Distributed
 failures").
 
-One-shot injected faults (``rank_kill`` / ``stall_rank`` in
-``LIGHTGBM_TPU_FAULT_INJECT``) are stripped from the environment on
-relaunch — consume-on-fire cannot survive a process restart, and
-without stripping the injected failure would recur every generation
-forever.
+Two supervision shapes share this module:
+
+- **World restart** (:func:`supervise`, the training shape): ranks
+  form ONE collective world, so the first nonzero exit kills the rest
+  and relaunches everything on a fresh coordinator port, resuming
+  from the newest checkpoint.
+- **Fleet restart** (:func:`supervise_fleet`, ``--health-port``; the
+  serving shape): ranks are INDEPENDENT replicas, so only the dead
+  one is relaunched while the others keep answering traffic. The
+  supervisor additionally health-checks each replica through the
+  daemon's own JSON ``{"cmd": "ping"}`` protocol on
+  ``health_port + rank`` — a replica that is alive-but-wedged (no
+  exit code will ever come) fails ``--health-fails`` consecutive
+  pings and is killed and relaunched like a dead one.
+
+Both shapes draw restarts from one :class:`RestartBudget`: a total
+cap (``--max-restarts``) plus an optional SLIDING WINDOW cap
+(``--max-restarts-per-window`` within ``--restart-window`` seconds) so
+a crash-loop burns out quickly instead of thrashing for hours at a
+slow total budget, and each restart waits out a jittered exponential
+backoff (base 0.5 s doubling per consecutive failure, 15 s cap —
+``init_distributed``'s retry shape) counted in the
+``supervisor_restarts`` / ``supervisor_backoff_seconds`` registry
+counters.
+
+One-shot injected faults (``rank_kill`` / ``stall_rank`` /
+``serve_kill`` in ``LIGHTGBM_TPU_FAULT_INJECT``) are stripped from the
+environment on relaunch — consume-on-fire cannot survive a process
+restart, and without stripping the injected failure would recur every
+generation forever.
 
 This module (and the whole ``launch`` dispatch in ``__main__``) never
 imports jax: the supervisor must stay alive and tiny while worlds die
@@ -35,22 +60,32 @@ around it, and must not pin accelerator devices the workers need.
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import random
 import signal
 import socket
 import subprocess
 import sys
 import time
+from collections import deque
 from typing import Dict, List, Optional, Sequence
 
 from ..utils.log import log_info, log_warning
 
-__all__ = ["main", "supervise", "worker_env", "strip_one_shot_faults"]
+__all__ = ["main", "supervise", "supervise_fleet", "worker_env",
+           "strip_one_shot_faults", "RestartBudget", "replica_ping",
+           "replica_rpc"]
 
 #: fault kinds that must not re-fire after a supervised restart
-_ONE_SHOT_KINDS = ("rank_kill", "stall_rank")
+_ONE_SHOT_KINDS = ("rank_kill", "stall_rank", "serve_kill")
 
 _POLL_SECONDS = 0.2
+
+#: jittered exponential backoff shape between restarts (mirrors
+#: parallel/distributed.py init_distributed's retry curve)
+_BACKOFF_BASE_SEC = 0.5
+_BACKOFF_CAP_SEC = 15.0
 
 
 def _free_port() -> int:
@@ -73,8 +108,94 @@ def _kill_group(proc: subprocess.Popen) -> None:
             pass
 
 
+from ..obs.registry import bump_counter as _count
+
+
+class RestartBudget:
+    """Total + sliding-window restart admission, with the jittered
+    exponential backoff delay to respect before each admitted restart.
+
+    ``admit()`` returns None when a restart may proceed (recording it
+    against both budgets) or a human-readable refusal. ``backoff()``
+    returns the pre-restart delay for the ``consecutive``-th failure
+    in a row and counts it in ``supervisor_backoff_seconds``.
+    """
+
+    def __init__(self, max_restarts: int,
+                 max_per_window: int = 0,
+                 window_sec: float = 300.0,
+                 backoff_base_sec: float = _BACKOFF_BASE_SEC,
+                 _now=time.monotonic,
+                 _rng: Optional[random.Random] = None):
+        self.max_restarts = int(max_restarts)
+        self.max_per_window = int(max_per_window)
+        self.window_sec = float(window_sec)
+        self.backoff_base_sec = float(backoff_base_sec)
+        self.total = 0
+        self._times: deque = deque()
+        self._now = _now
+        self._rng = _rng if _rng is not None else random.Random()
+
+    def admit(self) -> Optional[str]:
+        now = self._now()
+        if self.total >= self.max_restarts:
+            return f"the total restart budget ({self.max_restarts}) " \
+                   "is spent"
+        if self.max_per_window > 0:
+            while self._times and now - self._times[0] > self.window_sec:
+                self._times.popleft()
+            if len(self._times) >= self.max_per_window:
+                return (f"{len(self._times)} restarts within the last "
+                        f"{self.window_sec:g}s sliding window "
+                        f"(--max-restarts-per-window "
+                        f"{self.max_per_window}) — this is a crash "
+                        "loop, not a transient fault")
+        self.total += 1
+        self._times.append(now)
+        _count("supervisor_restarts")
+        return None
+
+    def backoff(self, consecutive: int) -> float:
+        """Jittered exponential delay before the ``consecutive``-th
+        restart in a row (1-based): base x 2^(n-1), capped, x[0.5,
+        1.5) jitter so simultaneously-restarting supervisors do not
+        stampede one coordinator/port."""
+        exp = max(0, int(consecutive) - 1)
+        delay = min(_BACKOFF_CAP_SEC, self.backoff_base_sec * (2 ** exp))
+        delay *= 0.5 + self._rng.random()
+        _count("supervisor_backoff_seconds", delay)
+        return delay
+
+
+def replica_rpc(port: int, obj: Dict, timeout: float = 5.0,
+                host: str = "127.0.0.1") -> Optional[Dict]:
+    """One request -> one reply against a serve replica's JSON-lines
+    protocol; None on any transport/parse failure, never an exception
+    — the callers are supervision/polling loops."""
+    try:
+        with socket.create_connection((host, port),
+                                      timeout=timeout) as s:
+            s.settimeout(timeout)
+            s.sendall((json.dumps(obj) + "\n").encode("utf-8"))
+            fh = s.makefile("r", encoding="utf-8")
+            line = fh.readline()
+        out = json.loads(line)
+        return out if isinstance(out, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def replica_ping(port: int, timeout: float = 5.0,
+                 host: str = "127.0.0.1") -> bool:
+    """One health probe: the daemon's ``{"cmd": "ping"}`` answered
+    with ``ok``."""
+    reply = replica_rpc(port, {"cmd": "ping"}, timeout=timeout,
+                        host=host)
+    return bool(reply and reply.get("ok"))
+
+
 def strip_one_shot_faults(spec: str) -> str:
-    """Drop ``rank_kill``/``stall_rank`` tokens from a
+    """Drop ``rank_kill``/``stall_rank``/``serve_kill`` tokens from a
     ``LIGHTGBM_TPU_FAULT_INJECT`` value for a relaunch."""
     kept = [tok for tok in spec.split(",")
             if tok.strip()
@@ -169,14 +290,20 @@ def _wait_generation(procs: List[subprocess.Popen],
 def supervise(nprocs: int, cmd: Sequence[str], max_restarts: int = 3,
               port: Optional[int] = None, log_dir: str = ".",
               grace: float = 5.0,
-              env: Optional[Dict[str, str]] = None) -> int:
+              env: Optional[Dict[str, str]] = None,
+              max_restarts_per_window: int = 0,
+              restart_window_sec: float = 300.0) -> int:
     """Run ``cmd`` as an ``nprocs``-rank world under supervision;
     returns the final exit code (0 = a generation completed cleanly).
 
     Each generation gets a fresh coordinator port — the previous
     coordinator died with its rank-0 worker, and its socket may linger
     in TIME_WAIT. Worker output goes to
-    ``{log_dir}/elastic_g{generation}_rank{rank}.log``.
+    ``{log_dir}/elastic_g{generation}_rank{rank}.log``. Restarts draw
+    from a :class:`RestartBudget` (total cap + optional sliding
+    window) and each one waits out a jittered exponential backoff so
+    a crash-looping world cannot thrash coordinator ports at full
+    speed.
     """
     if nprocs < 1:
         raise ValueError("nprocs must be >= 1")
@@ -184,7 +311,10 @@ def supervise(nprocs: int, cmd: Sequence[str], max_restarts: int = 3,
         raise ValueError("no worker command given (pass it after --)")
     base_env = dict(os.environ if env is None else env)
     os.makedirs(log_dir, exist_ok=True)
+    budget = RestartBudget(max_restarts, max_restarts_per_window,
+                           restart_window_sec)
     generation = 0
+    consecutive = 0
     while True:
         gen_port = port if port else _free_port()
         log_info(f"elastic: generation {generation}: launching "
@@ -202,21 +332,189 @@ def supervise(nprocs: int, cmd: Sequence[str], max_restarts: int = 3,
             log_info(f"elastic: generation {generation} completed "
                      "cleanly")
             return 0
-        if generation >= max_restarts:
+        refusal = budget.admit()
+        if refusal is not None:
             log_warning(
                 f"elastic: generation {generation} failed (exit {rc}) "
-                f"and the restart budget ({max_restarts}) is spent — "
-                "giving up")
+                f"and {refusal} — giving up")
             return rc
         generation += 1
+        consecutive += 1
         try:
             from ..obs.registry import registry
             registry.counter("elastic_restarts").inc()
         except Exception:
             pass
+        delay = budget.backoff(consecutive)
         log_info(f"elastic: restarting the world (restart {generation}"
-                 f"/{max_restarts}); training resumes from the newest "
-                 "checkpoint if LIGHTGBM_TPU_CHECKPOINT is set")
+                 f"/{max_restarts}) in {delay:.2f}s; training resumes "
+                 "from the newest checkpoint if LIGHTGBM_TPU_CHECKPOINT "
+                 "is set")
+        time.sleep(delay)
+
+
+class _Replica:
+    """One independently-supervised fleet member."""
+
+    __slots__ = ("rank", "proc", "generation", "launched_at",
+                 "consecutive_restarts", "ping_failures", "done",
+                 "relaunch_at")
+
+    def __init__(self, rank: int):
+        self.rank = rank
+        self.proc: Optional[subprocess.Popen] = None
+        self.generation = 0
+        self.launched_at = 0.0
+        self.consecutive_restarts = 0
+        self.ping_failures = 0
+        self.done = False           # exited 0: intentional, no restart
+        # backoff deadline of a scheduled relaunch (None = running):
+        # a per-replica NOT-BEFORE time, never an inline sleep — one
+        # replica's backoff must not stall supervision of the others
+        self.relaunch_at: Optional[float] = None
+
+
+def _launch_replica(rep: _Replica, cmd: Sequence[str], nprocs: int,
+                    log_dir: str, base_env: Dict[str, str]) -> None:
+    log_path = os.path.join(
+        log_dir, f"elastic_g{rep.generation}_rank{rep.rank}.log")
+    log_file = open(log_path, "ab")
+    try:
+        rep.proc = subprocess.Popen(
+            list(cmd),
+            env=worker_env(base_env, rep.rank, nprocs, _free_port(),
+                           rep.generation),
+            stdout=log_file, stderr=subprocess.STDOUT,
+            start_new_session=True)
+    finally:
+        log_file.close()
+    rep.launched_at = time.monotonic()
+    rep.ping_failures = 0
+
+
+def supervise_fleet(nprocs: int, cmd: Sequence[str],
+                    max_restarts: int = 3,
+                    log_dir: str = ".", grace: float = 5.0,
+                    env: Optional[Dict[str, str]] = None,
+                    max_restarts_per_window: int = 0,
+                    restart_window_sec: float = 300.0,
+                    health_port: Optional[int] = None,
+                    health_interval: float = 2.0,
+                    health_fails: int = 3,
+                    health_grace: float = 60.0,
+                    health_timeout: float = 5.0) -> int:
+    """Supervise ``nprocs`` INDEPENDENT replicas (the serving shape):
+    a dead or health-check-failing replica is relaunched alone, on a
+    per-replica jittered backoff, while the rest keep serving.
+
+    ``health_port``: base port of the replicas' JSON protocol — rank
+    ``r`` is pinged on ``health_port + r`` every ``health_interval``
+    seconds once its ``health_grace`` startup window (model load +
+    compile) has passed; ``health_fails`` consecutive failures mean
+    alive-but-wedged, and the replica is killed and relaunched. None
+    disables pinging (exit-code supervision only).
+
+    Returns 0 once every replica has exited cleanly (a graceful
+    ``shutdown``), or the last failing exit code when the restart
+    budget (shared across the fleet) is exhausted.
+    """
+    if nprocs < 1:
+        raise ValueError("nprocs must be >= 1")
+    if not cmd:
+        raise ValueError("no worker command given (pass it after --)")
+    base_env = dict(os.environ if env is None else env)
+    os.makedirs(log_dir, exist_ok=True)
+    budget = RestartBudget(max_restarts, max_restarts_per_window,
+                           restart_window_sec)
+    fleet = [_Replica(rank) for rank in range(nprocs)]
+    last_rc = 1
+    next_ping = time.monotonic() + max(0.0, health_grace)
+    try:
+        for rep in fleet:
+            _launch_replica(rep, cmd, nprocs, log_dir, base_env)
+        while True:
+            now = time.monotonic()
+            ping_round = health_port is not None and now >= next_ping
+            if ping_round:
+                next_ping = now + max(0.1, health_interval)
+            for rep in fleet:
+                if rep.done:
+                    continue
+                if rep.relaunch_at is not None:
+                    # backoff pending: relaunch once the per-replica
+                    # deadline passes (other replicas keep being
+                    # polled/pinged in the meantime)
+                    if now >= rep.relaunch_at:
+                        rep.relaunch_at = None
+                        _launch_replica(rep, cmd, nprocs, log_dir,
+                                        base_env)
+                    continue
+                if rep.proc is None:
+                    continue
+                rc = rep.proc.poll()
+                needs_restart = False
+                if rc is not None:
+                    if rc == 0:
+                        log_info(f"elastic: replica {rep.rank} exited "
+                                 "cleanly")
+                        rep.done = True
+                        continue
+                    last_rc = (128 - rc) if rc < 0 else rc
+                    log_warning(f"elastic: replica {rep.rank} exited "
+                                f"with code {rc}")
+                    needs_restart = True
+                elif ping_round and \
+                        now - rep.launched_at >= health_grace:
+                    if replica_ping(health_port + rep.rank,
+                                    timeout=health_timeout):
+                        rep.ping_failures = 0
+                        rep.consecutive_restarts = 0
+                    else:
+                        rep.ping_failures += 1
+                        if rep.ping_failures >= max(1, health_fails):
+                            log_warning(
+                                f"elastic: replica {rep.rank} failed "
+                                f"{rep.ping_failures} consecutive "
+                                "health checks (alive but wedged); "
+                                "killing it for relaunch")
+                            _kill_group(rep.proc)
+                            try:
+                                rep.proc.wait(timeout=max(1.0, grace))
+                            except subprocess.TimeoutExpired:
+                                _kill_group(rep.proc)
+                            last_rc = 1
+                            needs_restart = True
+                if not needs_restart:
+                    continue
+                refusal = budget.admit()
+                if refusal is None:
+                    # generation bump strips one-shot faults
+                    # (worker_env) so an injected serve_kill cannot
+                    # re-fire on every relaunch forever
+                    rep.generation += 1
+                    rep.consecutive_restarts += 1
+                    delay = budget.backoff(rep.consecutive_restarts)
+                    rep.relaunch_at = now + delay
+                    log_info(f"elastic: relaunching replica "
+                             f"{rep.rank} (generation "
+                             f"{rep.generation}) in {delay:.2f}s")
+                else:
+                    log_warning(f"elastic: replica {rep.rank} died "
+                                f"and {refusal} — stopping the fleet")
+                    for other in fleet:
+                        if other.proc is not None \
+                                and other.proc.poll() is None:
+                            _kill_group(other.proc)
+                    return last_rc
+            if all(rep.done for rep in fleet):
+                log_info("elastic: every replica exited cleanly")
+                return 0
+            time.sleep(_POLL_SECONDS)
+    except BaseException:          # ctrl-C etc.: never leak replicas
+        for rep in fleet:
+            if rep.proc is not None and rep.proc.poll() is None:
+                _kill_group(rep.proc)
+        raise
 
 
 _HELP_EPILOG = """\
@@ -249,6 +547,27 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("nprocs", type=int, help="number of ranks to spawn")
     p.add_argument("--max-restarts", type=int, default=3,
                    help="world restarts before giving up (default 3)")
+    p.add_argument("--max-restarts-per-window", type=int, default=0,
+                   help="sliding-window restart cap: give up when this "
+                        "many restarts land within --restart-window "
+                        "seconds (crash-loop brake; 0 = disabled)")
+    p.add_argument("--restart-window", type=float, default=300.0,
+                   help="width in seconds of the sliding restart "
+                        "window (default 300)")
+    p.add_argument("--health-port", type=int, default=None,
+                   help="FLEET MODE: supervise ranks as independent "
+                        "replicas (restart only the dead one) and "
+                        "health-check rank r via the serve daemon's "
+                        "{\"cmd\": \"ping\"} on this port + r")
+    p.add_argument("--health-interval", type=float, default=2.0,
+                   help="seconds between health pings (fleet mode)")
+    p.add_argument("--health-fails", type=int, default=3,
+                   help="consecutive ping failures before a replica "
+                        "is declared wedged and relaunched")
+    p.add_argument("--health-grace", type=float, default=60.0,
+                   help="startup window in seconds during which a "
+                        "(re)launched replica is not pinged (model "
+                        "load + compile)")
     p.add_argument("--port", type=int, default=0,
                    help="fixed coordinator port (default: a fresh free "
                         "port per generation)")
@@ -286,10 +605,22 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.checkpoint_dir:
         env["LIGHTGBM_TPU_CHECKPOINT"] = args.checkpoint_dir
     try:
+        if args.health_port is not None:
+            return supervise_fleet(
+                args.nprocs, cmd, max_restarts=args.max_restarts,
+                log_dir=args.log_dir, grace=args.grace, env=env,
+                max_restarts_per_window=args.max_restarts_per_window,
+                restart_window_sec=args.restart_window,
+                health_port=args.health_port,
+                health_interval=args.health_interval,
+                health_fails=args.health_fails,
+                health_grace=args.health_grace)
         return supervise(args.nprocs, cmd,
                          max_restarts=args.max_restarts,
                          port=args.port or None, log_dir=args.log_dir,
-                         grace=args.grace, env=env)
+                         grace=args.grace, env=env,
+                         max_restarts_per_window=args.max_restarts_per_window,
+                         restart_window_sec=args.restart_window)
     except KeyboardInterrupt:
         print("launch: interrupted", file=sys.stderr)
         return 130

@@ -41,6 +41,26 @@ the launch supervisor, so these are additionally gated on
   connection-refused error on its first ``K`` attempts (coordinator
   not up yet), exercising the retry/backoff loop; fires on every rank.
 
+Lifecycle kinds (docs/PIPELINE.md; the continuous
+train -> publish -> serve loop):
+
+- ``publish_torn@G`` — the generation-``G`` model publication
+  (resilience/publisher.py) first leaves a TORN artifact behind (a
+  truncated model file written non-atomically, the crash-mid-write
+  shape the atomic helper exists to prevent) and fails, exercising
+  both the publisher's retry/backoff loop and the serve watcher's
+  manifest validation + skip-and-retry path.
+- ``serve_kill@N`` — ``SIGKILL`` the serving daemon at its ``N``-th
+  accepted predict request, *before* the request enters the batcher
+  (an accepted request must never be silently dropped — a killed
+  connection is a client-visible error). Gated on
+  ``LIGHTGBM_TPU_FAULT_RANK`` against the replica's
+  ``LIGHTGBM_TPU_RANK`` (serve replicas are independent single-process
+  jax runtimes, so ``jax.process_index()`` cannot tell them apart).
+- ``refit_nan@T`` — poison the gradient vector of tree ``T`` during a
+  ``Booster.refit`` (warm-start leaf re-derivation), exercising the
+  refit-side non-finite guard (``nonfinite_policy``).
+
 A missing / empty variable parses to an inert plan: every query is a
 cheap tuple-membership test, nothing touches jax, and production runs
 pay nothing.
@@ -59,7 +79,8 @@ __all__ = ["FaultPlan", "InjectedResourceExhausted", "InjectedInitRefused",
            "record_fault_event", "drain_events", "FAULT_EVENTS"]
 
 _KNOWN_KINDS = ("nan_grad", "nan_hess", "oom", "kill",
-                "rank_kill", "stall_rank", "init_refuse")
+                "rank_kill", "stall_rank", "init_refuse",
+                "publish_torn", "serve_kill", "refit_nan")
 
 #: process-level fault event log for faults that have no engine to hang
 #: off (init retries, watchdog timeouts, distributed injections). The
@@ -243,6 +264,32 @@ class FaultPlan:
                        "(LIGHTGBM_TPU_FAULT_INJECT)")
             while True:
                 time.sleep(3600.0)
+
+    @staticmethod
+    def _replica_selected() -> bool:
+        """Is THIS serve replica a fault target? Serve replicas are
+        independent single-process jax runtimes distinguished only by
+        the supervisor-exported ``LIGHTGBM_TPU_RANK``, so the gate
+        compares that (not ``jax.process_index()``, which is 0 in
+        every replica) against ``LIGHTGBM_TPU_FAULT_RANK``."""
+        targets = {int(r) for r in
+                   os.environ.get("LIGHTGBM_TPU_FAULT_RANK",
+                                  "0").split(",") if r.strip()}
+        me = int(os.environ.get("LIGHTGBM_TPU_RANK", "0") or 0)
+        return me in targets
+
+    def maybe_serve_kill(self, request_count: int) -> None:
+        """SIGKILL the serving daemon when armed for this accepted
+        request ordinal (and this replica is a selected rank) —
+        the mid-traffic replica death the launch supervisor's health
+        checks and per-rank restarts must absorb. Fired BEFORE the
+        request enters the batcher, so no accepted request is ever
+        silently dropped (the dying connection is the client's
+        signal to retry)."""
+        if self.fires("serve_kill", request_count) \
+                and self._replica_selected():
+            self.take("serve_kill", request_count)
+            os.kill(os.getpid(), signal.SIGKILL)
 
     def maybe_refuse_init(self) -> None:
         """Raise one synthetic connection-refused error per remaining

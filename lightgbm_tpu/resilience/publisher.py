@@ -1,0 +1,250 @@
+"""Atomic model publication: the train -> serve handoff.
+
+The missing edge of the continuous lifecycle (docs/PIPELINE.md):
+training produces a model, the serve daemon (serve/daemon.py) polls a
+``--watch-dir`` for the newest artifact — this module is the writer
+side of that contract, and it must survive being killed at any byte.
+
+Protocol (manifest-first):
+
+1. ``<name>.manifest.json`` is written atomically (same-dir tmp +
+   ``os.replace``, utils/atomic.py) carrying the artifact's identity:
+   its exact byte length and sha256, plus caller metadata (generation,
+   data digest, train metrics). The manifest lands BEFORE the model
+   file it describes, so a watcher can validate every model artifact
+   it ever observes.
+2. ``<name>`` (the model text) is written atomically.
+
+A watcher that finds a model whose bytes do not match its manifest is
+looking at a TORN publication — a writer that died between the two
+steps, or a non-atomic writer mid-write. The serve watcher skips such
+an artifact with a ``swap_failure`` fault event and retries next poll
+(the atomic re-publish below will replace it); it never swaps to it.
+Artifacts without a manifest (hand-dropped model files, checkpoint
+snapshots) keep the legacy behavior: served as-is once they parse.
+
+Transient publication failures (full disk, a slow NFS rename, the
+injected ``publish_torn@G`` chaos kind) are retried with jittered
+exponential backoff — the same retry shape as
+``init_distributed`` — and counted in the ``publish_retries`` /
+``publish_backoff_seconds`` registry counters.
+
+This module never imports jax: the pipeline supervisor and the serve
+watcher both consume it on jax-free paths.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..obs.registry import bump_counter as _count
+from ..utils.atomic import atomic_write_bytes
+from ..utils.log import log_info, log_warning
+
+__all__ = ["PublishError", "publish_model", "manifest_path",
+           "load_manifest", "validate_artifact", "latest_manifest"]
+
+MANIFEST_MAGIC = "lightgbm_tpu.publish.v1"
+MANIFEST_SUFFIX = ".manifest.json"
+
+#: retry/backoff defaults — overridable per call and via Config
+#: (publish_retries / publish_backoff_sec, docs/PARAMETERS.md)
+DEFAULT_RETRIES = 5
+DEFAULT_BACKOFF_SEC = 0.25
+BACKOFF_CAP_SEC = 15.0
+
+
+class PublishError(RuntimeError):
+    """A model publication failed (exhausted retries), or an artifact
+    failed its manifest validation (torn / partial write)."""
+
+
+def manifest_path(model_path) -> str:
+    return os.fspath(model_path) + MANIFEST_SUFFIX
+
+
+def _sha256_hex(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def publish_model(model, directory, name: str, *,
+                  metadata: Optional[Dict[str, Any]] = None,
+                  retries: int = DEFAULT_RETRIES,
+                  backoff_base_sec: float = DEFAULT_BACKOFF_SEC,
+                  fault_iteration: int = -1,
+                  _sleep: Callable[[float], None] = time.sleep,
+                  _rng: Callable[[], float] = random.random
+                  ) -> Dict[str, Any]:
+    """Publish ``model`` into ``directory`` as ``name`` with a
+    validating manifest; returns the manifest dict.
+
+    ``model`` is a model-text string or anything with
+    ``model_to_string()`` (a Booster). ``metadata`` is merged into the
+    manifest (generation number, data digest, train metrics — whatever
+    the retrain loop wants the serve side and post-mortems to see).
+    ``fault_iteration`` keys the ``publish_torn@G`` chaos kind
+    (typically the retrain generation number).
+
+    Transient failures (OSError, injected tears) retry up to
+    ``retries`` times with jittered exponential backoff
+    (``backoff_base_sec`` doubling per attempt, capped at 15 s,
+    x[0.5, 1.5) jitter); exhaustion raises :class:`PublishError`.
+    """
+    if not isinstance(model, str):
+        model = model.model_to_string()
+    payload = model.encode("utf-8")
+    directory = os.fspath(directory)
+    target = os.path.join(directory, name)
+    manifest = {
+        "magic": MANIFEST_MAGIC,
+        "file": name,
+        "bytes": len(payload),
+        "sha256": _sha256_hex(payload),
+        "created_unix": time.time(),
+        **(metadata or {}),
+    }
+    from .faults import FaultPlan, record_fault_event
+    plan = FaultPlan.from_env()
+    last_err: Optional[BaseException] = None
+    for attempt in range(max(0, int(retries)) + 1):
+        try:
+            # manifest FIRST: every model artifact a watcher can ever
+            # observe under this protocol is validatable
+            atomic_write_bytes(
+                manifest_path(target),
+                (json.dumps(manifest) + "\n").encode("utf-8"))
+            if plan.take("publish_torn", fault_iteration):
+                # chaos: leave the torn artifact a crashed / non-atomic
+                # writer would — a partial prefix, written in place —
+                # then fail this attempt so the retry loop (and the
+                # watcher's validation) must both do their jobs
+                with open(target, "wb") as fh:
+                    fh.write(payload[: max(1, len(payload) // 3)])
+                record_fault_event(
+                    "publish_torn", iteration=fault_iteration,
+                    action="retry",
+                    detail=f"injected torn publish of {name} "
+                           "(LIGHTGBM_TPU_FAULT_INJECT)")
+                raise PublishError(
+                    f"injected torn publish of {name} "
+                    "(LIGHTGBM_TPU_FAULT_INJECT)")
+            atomic_write_bytes(target, payload)
+        except (OSError, PublishError) as e:
+            last_err = e
+            if attempt >= retries:
+                break
+            delay = min(BACKOFF_CAP_SEC,
+                        float(backoff_base_sec) * (2 ** attempt))
+            delay *= 0.5 + _rng()            # jitter: x[0.5, 1.5)
+            _count("publish_retries")
+            _count("publish_backoff_seconds", delay)
+            log_warning(f"publish: attempt {attempt + 1} for {name} "
+                        f"failed ({e}); retrying in {delay:.2f}s")
+            _sleep(delay)
+            continue
+        _count("publish_total")
+        log_info(f"publish: wrote {target} "
+                 f"({len(payload)} bytes, sha256 "
+                 f"{manifest['sha256'][:12]}…)")
+        return manifest
+    _count("publish_failures")
+    raise PublishError(
+        f"publishing {name} into {directory} failed after "
+        f"{retries + 1} attempt(s): {last_err}") from last_err
+
+
+def load_manifest(model_path) -> Optional[Dict[str, Any]]:
+    """The manifest published alongside ``model_path``, or None when
+    the artifact is unmanaged (no sidecar). A sidecar that exists but
+    is unreadable/foreign raises :class:`PublishError` — a manifest
+    is written atomically, so garbage there is corruption, not a
+    mid-write artifact."""
+    path = manifest_path(model_path)
+    try:
+        with open(path, "rb") as fh:
+            raw = fh.read()
+    except FileNotFoundError:
+        return None
+    except OSError as e:
+        raise PublishError(f"{path}: unreadable manifest ({e})") from e
+    try:
+        manifest = json.loads(raw.decode("utf-8"))
+    except (UnicodeDecodeError, ValueError) as e:
+        raise PublishError(f"{path}: malformed manifest ({e})") from e
+    if not isinstance(manifest, dict) \
+            or manifest.get("magic") != MANIFEST_MAGIC:
+        raise PublishError(f"{path}: bad manifest magic "
+                           f"{manifest.get('magic') if isinstance(manifest, dict) else None!r}")
+    return manifest
+
+
+def validate_artifact(model_path) -> Optional[Dict[str, Any]]:
+    """Validate ``model_path`` against its published manifest.
+
+    Returns the manifest when the bytes match, None when the artifact
+    carries no manifest (legacy / hand-dropped file — the caller
+    decides whether to trust it), and raises :class:`PublishError` on
+    a mismatch: the artifact is torn (a publisher died between the
+    manifest and the model write, or a non-atomic writer is mid-way
+    through) and must not be served."""
+    manifest = load_manifest(model_path)
+    if manifest is None:
+        return None
+    with open(model_path, "rb") as fh:
+        data = fh.read()
+    if len(data) != int(manifest.get("bytes", -1)) \
+            or _sha256_hex(data) != manifest.get("sha256"):
+        raise PublishError(
+            f"{os.fspath(model_path)}: torn or partial artifact — "
+            f"{len(data)} bytes on disk vs {manifest.get('bytes')} "
+            "published (sha256 mismatch); a publisher retry or the "
+            "next atomic replace will supersede it")
+    return manifest
+
+
+def latest_manifest(directory) -> Optional[Tuple[str, Dict[str, Any]]]:
+    """Newest VALIDATED publication in ``directory``:
+    ``(model_path, manifest)`` by manifest creation time, skipping
+    torn or unreadable entries (with a warning). None when nothing
+    validates — the warm-start path then trains from scratch.
+
+    Ordering comes from the (cheap, json-read) manifests alone;
+    artifact bytes are only hashed newest-first until one validates —
+    a long-lived publish directory is not re-hashed end to end on
+    every generation."""
+    directory = os.fspath(directory)
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    candidates: List[Tuple[float, str, Dict[str, Any]]] = []
+    for nm in names:
+        if not nm.endswith(MANIFEST_SUFFIX):
+            continue
+        model_path = os.path.join(
+            directory, nm[: -len(MANIFEST_SUFFIX)])
+        try:
+            manifest = load_manifest(model_path)
+        except PublishError as e:
+            log_warning(f"publish: skipping unusable publication "
+                        f"{model_path!r} ({e})")
+            continue
+        if manifest is None:
+            continue
+        candidates.append(
+            (float(manifest.get("created_unix", 0.0)), model_path,
+             manifest))
+    for _, model_path, manifest in sorted(candidates, reverse=True,
+                                          key=lambda c: (c[0], c[1])):
+        try:
+            if validate_artifact(model_path) is not None:
+                return model_path, manifest
+        except (PublishError, OSError) as e:
+            log_warning(f"publish: skipping unusable publication "
+                        f"{model_path!r} ({e})")
+    return None

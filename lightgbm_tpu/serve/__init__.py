@@ -21,6 +21,7 @@ _LAZY = {
     "CompiledForest": "compile", "compile_forest": "compile",
     "bucket_rows": "compile",
     "MicroBatcher": "batcher", "QueueFullError": "batcher",
+    "SheddingError": "batcher",
     "main": "daemon", "handle_request": "daemon", "ServeState": "daemon",
 }
 

@@ -9,6 +9,19 @@ each caller's Future. Backpressure is a hard row budget
 :class:`QueueFullError` instead of growing an unbounded queue — the
 daemon surfaces that as an ``overloaded`` error line.
 
+Load shedding (docs/SERVING.md "Overload policy") sits between
+healthy operation and that hard wall: when ``shed_queue_rows`` > 0
+and the pending backlog exceeds it, the worker sheds the OLDEST
+queued requests — resolving their futures with a typed
+:class:`SheddingError` the daemon maps to a ``{"shed": true}`` reply
+— until the backlog is back under the threshold; fresh arrivals keep
+being served at bounded latency instead of every caller timing out
+together. ``shed_p99_ms`` > 0 additionally sheds any request that
+has already waited past that latency budget at dequeue time (its
+deadline is blown; finishing it would only steal capacity from
+requests that can still meet theirs). Both thresholds default to 0 =
+disabled: shedding is an explicit operational choice.
+
 Threading contract (enforced by tpulint TPL006/TPL008 over serve/):
 every mutable field shared between the worker and callers is touched
 only under ``self._lock``, the request handoff itself rides a
@@ -28,7 +41,7 @@ from typing import List, Optional
 
 import numpy as np
 
-__all__ = ["MicroBatcher", "QueueFullError"]
+__all__ = ["MicroBatcher", "QueueFullError", "SheddingError"]
 
 #: latency samples kept for the p50/p99 window (newest-wins ring)
 _LATENCY_WINDOW = 4096
@@ -38,6 +51,14 @@ _STOP = object()
 
 class QueueFullError(RuntimeError):
     """Backpressure: the batcher's pending-row budget is exhausted."""
+
+
+class SheddingError(RuntimeError):
+    """Load shedding: the request was accepted but dropped by the
+    overload policy (queue depth or per-request latency budget breach)
+    before reaching the device — the typed signal for "retry later /
+    against another replica", distinct from the hard
+    :class:`QueueFullError` admission rejection."""
 
 
 class _Request:
@@ -76,16 +97,31 @@ class MicroBatcher:
 
     def __init__(self, forest, batch_window_ms: float = 2.0,
                  max_batch_rows: int = 16384,
-                 queue_max_rows: int = 131072):
+                 queue_max_rows: int = 131072,
+                 shed_queue_rows: int = 0,
+                 shed_p99_ms: float = 0.0):
         if batch_window_ms < 0:
             raise ValueError("batch_window_ms must be >= 0")
         if max_batch_rows < 1 or queue_max_rows < 1:
             raise ValueError("max_batch_rows and queue_max_rows must "
                              "be >= 1")
+        if shed_queue_rows < 0 or shed_p99_ms < 0:
+            raise ValueError("shed_queue_rows and shed_p99_ms must be "
+                             ">= 0 (0 disables shedding)")
+        if shed_queue_rows and shed_queue_rows >= queue_max_rows:
+            # the same invariant Config enforces — re-checked here so
+            # the serve CLI's flags (which never build a Config) cannot
+            # silently configure shedding that can never fire
+            raise ValueError(
+                "shed_queue_rows (soft shed threshold) must stay below "
+                f"queue_max_rows (hard admission wall) to ever fire "
+                f"({shed_queue_rows} >= {queue_max_rows})")
         self._forest = forest
         self._window_s = float(batch_window_ms) / 1e3
         self._max_batch_rows = int(max_batch_rows)
         self._queue_max_rows = int(queue_max_rows)
+        self._shed_queue_rows = int(shed_queue_rows)
+        self._shed_p99_ms = float(shed_p99_ms)
         self._queue: "queue.Queue" = queue.Queue()
         self._lock = threading.Lock()
         # ---- all fields below are guarded by self._lock ----
@@ -95,6 +131,8 @@ class MicroBatcher:
         self._batches_total = 0
         self._swaps_total = 0
         self._rejected_total = 0
+        self._shed_total = 0
+        self._shed_rows = 0
         self._latencies = deque(maxlen=_LATENCY_WINDOW)
         self._closed = False
         self._worker = threading.Thread(
@@ -192,6 +230,8 @@ class MicroBatcher:
                 "batches_total": self._batches_total,
                 "swaps_total": self._swaps_total,
                 "rejected_total": self._rejected_total,
+                "shed_total": self._shed_total,
+                "shed_rows": self._shed_rows,
             }
         if lat:
             q = np.percentile(np.asarray(lat, np.float64), [50.0, 99.0])
@@ -225,6 +265,39 @@ class MicroBatcher:
                                  "was served"))
 
     # -- worker side ---------------------------------------------------
+    def _maybe_shed(self, req: _Request) -> bool:
+        """Overload policy at dequeue time: shed ``req`` (resolve its
+        future with :class:`SheddingError`, True) when the pending
+        backlog exceeds ``shed_queue_rows`` or the request has already
+        waited past ``shed_p99_ms``. Runs on the worker thread only;
+        the bookkeeping writes share the caller-side lock."""
+        reason = None
+        age_ms = (time.perf_counter() - req.t_submit) * 1e3
+        n = req.rows.shape[0]
+        with self._lock:
+            # the backlog BEHIND this request decides the queue-depth
+            # shed: counting the request's own rows would deterministically
+            # shed any single request larger than the threshold even on
+            # an idle server
+            backlog = self._pending_rows - n
+            if 0 < self._shed_queue_rows < backlog:
+                reason = (f"{backlog} rows queued behind this request, "
+                          f"over the {self._shed_queue_rows}-row shed "
+                          "threshold; oldest requests are dropped so "
+                          "fresh ones keep bounded latency")
+            elif 0 < self._shed_p99_ms < age_ms:
+                reason = (f"request waited {age_ms:.1f} ms, past the "
+                          f"{self._shed_p99_ms:g} ms latency budget")
+            if reason is None:
+                return False
+            self._pending_rows -= n
+            self._shed_total += 1
+            self._shed_rows += n
+        req.future.set_exception(SheddingError(
+            f"request shed under load: {reason} (retry later or "
+            "against another replica)"))
+        return True
+
     def _worker_loop(self) -> None:
         while True:
             req = self._queue.get()
@@ -232,6 +305,8 @@ class MicroBatcher:
                 return
             if isinstance(req, _SwapCmd):
                 self._apply_swap(req)
+                continue
+            if self._maybe_shed(req):
                 continue
             batch: List[_Request] = [req]
             n = req.rows.shape[0]
@@ -252,6 +327,8 @@ class MicroBatcher:
                 if isinstance(nxt, _SwapCmd):
                     pending_swap = nxt   # close the batch, swap after
                     break
+                if self._maybe_shed(nxt):
+                    continue
                 batch.append(nxt)
                 n += nxt.rows.shape[0]
             self._run_batch(batch)

@@ -21,6 +21,19 @@ A stdlib-socket JSON-lines server over one compiled forest
   (utils/atomic.py) — compiles it off the serving path, and swaps it
   into the batcher. In-flight requests finish on the model they
   started with; the old forest's HBM is donated to the new upload.
+  Artifacts published with a manifest sidecar
+  (resilience/publisher.py, docs/PIPELINE.md) are sha256-validated
+  first: a TORN publication is skipped with a ``swap_failure`` fault
+  event and retried next poll, never served.
+
+- **Overload policy**: beyond the hard ``QueueFullError`` admission
+  wall, ``--shed-queue-rows`` / ``--shed-p99-ms`` shed the OLDEST
+  queued requests with a typed ``{"shed": true}`` reply
+  (docs/SERVING.md "Overload policy").
+
+- **Graceful shutdown**: SIGTERM and the ``shutdown`` command drain
+  accepted requests (bounded by ``--grace``) before the socket
+  closes — a supervised restart never drops an accepted request.
 
 - **Telemetry**: ``{"event": "serve"}`` JSONL lines every
   ``--stats-interval`` seconds (QPS, queue depth, p50/p99 latency,
@@ -70,9 +83,11 @@ class ServeState:
     """
 
     def __init__(self, batcher, model_id: str, model_source: str,
-                 registry=None, telemetry_path: Optional[str] = None):
+                 registry=None, telemetry_path: Optional[str] = None,
+                 manifest: Optional[Dict[str, Any]] = None):
         from ..obs import RecompileWatcher
         from ..obs.registry import registry as global_registry
+        from ..resilience.faults import FaultPlan
         self.batcher = batcher
         self.registry = registry if registry is not None \
             else global_registry
@@ -80,12 +95,18 @@ class ServeState:
         # ---- guarded by self._lock ----
         self._model_id = model_id
         self._model_source = model_source
+        self._manifest: Optional[Dict[str, Any]] = \
+            dict(manifest) if manifest else None
         self._swap_failures = 0
+        self._shed_replies = 0
+        self._requests_accepted = 0
+        self._active_handlers = 0
         self._last_stats: Dict[str, Any] = {}
         self._telemetry_file = None
         self.shutdown_event = threading.Event()
         self._t0 = time.monotonic()
         self._watcher = RecompileWatcher()
+        self.fault_plan = FaultPlan.from_env()
         if telemetry_path:
             try:
                 dirname = os.path.dirname(os.path.abspath(
@@ -107,16 +128,47 @@ class ServeState:
         with self._lock:
             return self._model_source
 
-    def note_swap(self, model_id: str, source: str) -> None:
+    def note_swap(self, model_id: str, source: str,
+                  manifest: Optional[Dict[str, Any]] = None) -> None:
         with self._lock:
             self._model_id = model_id
             self._model_source = source
+            self._manifest = dict(manifest) if manifest else None
         self.registry.counter("serve_swaps").inc()
 
     def note_swap_failure(self) -> None:
         with self._lock:
             self._swap_failures += 1
         self.registry.counter("serve_swap_failures").inc()
+
+    def note_shed(self) -> None:
+        with self._lock:
+            self._shed_replies += 1
+        self.registry.counter("serve_shed_requests").inc()
+
+    def count_request(self) -> int:
+        """Ordinal of this accepted predict request (1-based), feeding
+        the ``serve_kill@N`` chaos hook."""
+        with self._lock:
+            self._requests_accepted += 1
+            return self._requests_accepted
+
+    # -- graceful shutdown bookkeeping ---------------------------------
+    # in-flight REQUEST accounting, not connection accounting: a
+    # handler blocked reading an idle keep-alive connection has no
+    # reply pending and must not make the drain wait out the whole
+    # grace deadline
+    def handler_enter(self) -> None:
+        with self._lock:
+            self._active_handlers += 1
+
+    def handler_exit(self) -> None:
+        with self._lock:
+            self._active_handlers -= 1
+
+    def active_handlers(self) -> int:
+        with self._lock:
+            return self._active_handlers
 
     def request_shutdown(self) -> None:
         self.shutdown_event.set()
@@ -138,7 +190,9 @@ class ServeState:
         with self._lock:
             model_id = self._model_id
             source = self._model_source
+            manifest = dict(self._manifest) if self._manifest else None
             failures = self._swap_failures
+            shed_replies = self._shed_replies
             last = dict(self._last_stats)
             uptime = time.monotonic() - self._t0
             recompiles = {"delta": self._watcher.delta(),
@@ -152,7 +206,9 @@ class ServeState:
         out = dict(snap)
         out["model"] = model_id
         out["model_source"] = source
+        out["manifest"] = manifest
         out["swap_failures"] = failures
+        out["shed_replies"] = shed_replies
         out["uptime_s"] = round(uptime, 3)
         out["qps"] = round(dreq / dt, 3) if dt > 0 else 0.0
         out["rows_per_sec"] = round(drows / dt, 3) if dt > 0 else 0.0
@@ -165,13 +221,25 @@ class ServeState:
     def emit_serve_event(self) -> None:
         """One ``{"event": "serve"}`` JSONL line (degrades like the
         training recorder: an unwritable file stops the stream, never
-        serving)."""
+        serving). Process-level fault events (``swap_failure`` from
+        the watcher, shed records) are drained into the stream first,
+        mirroring the training recorder's contract that fault lines
+        precede the event that observed them."""
+        faults: List[dict] = []
+        try:
+            from ..resilience.faults import FAULT_EVENTS, drain_events
+            if FAULT_EVENTS:
+                faults = drain_events(FAULT_EVENTS)
+        except Exception:
+            pass
         payload = {"event": "serve", **self.stats()}
         with self._lock:
             fh = self._telemetry_file
             if fh is None:
                 return
             try:
+                for ev in faults:
+                    fh.write(json.dumps(ev) + "\n")
                 fh.write(json.dumps(payload) + "\n")
                 fh.flush()
             except OSError as e:
@@ -229,7 +297,12 @@ def handle_request(obj: Any, state: ServeState) -> Dict[str, Any]:
     if X.ndim != 2 or X.shape[0] == 0:
         return {"error": f"rows must be [n, n_features], got shape "
                          f"{X.shape}"}
-    from .batcher import QueueFullError
+    # chaos hook (resilience/faults.py serve_kill@N): fires BEFORE the
+    # request enters the batcher — a SIGKILLed replica must never hold
+    # an accepted-but-unanswered request; the dying connection is the
+    # client's retry signal
+    state.fault_plan.maybe_serve_kill(state.count_request())
+    from .batcher import QueueFullError, SheddingError
     try:
         fut = state.batcher.submit(X)
     except QueueFullError as e:
@@ -238,6 +311,10 @@ def handle_request(obj: Any, state: ServeState) -> Dict[str, Any]:
         return {"error": str(e)}
     try:
         raw_scores = fut.result()
+    except SheddingError as e:       # typed overload reply: the client
+        state.note_shed()            # should retry later / elsewhere
+        return {"error": str(e), "shed": True, "overloaded": True,
+                "model": state.model_id()}
     except Exception as e:                       # batch-level failure
         return {"error": f"prediction failed: {e}"}
     # finalize with the forest that PRODUCED the scores (stamped on
@@ -265,19 +342,31 @@ class _Handler(socketserver.StreamRequestHandler):
             line = raw.strip()
             if not line:
                 continue
+            # count the REQUEST as in flight from parse to flushed
+            # reply — the graceful drain waits for exactly this window,
+            # never for handlers idling between pipelined requests
+            state.handler_enter()
             try:
-                obj = json.loads(line.decode("utf-8"))
-            except (UnicodeDecodeError, ValueError):
-                resp = {"error": "malformed JSON line"}
-            else:
-                resp = handle_request(obj, state)
-            try:
-                self.wfile.write((json.dumps(resp) + "\n")
-                                 .encode("utf-8"))
-                self.wfile.flush()
-            except OSError:
-                return                      # client went away mid-reply
+                try:
+                    obj = json.loads(line.decode("utf-8"))
+                except (UnicodeDecodeError, ValueError):
+                    resp = {"error": "malformed JSON line"}
+                else:
+                    resp = handle_request(obj, state)
+                try:
+                    self.wfile.write((json.dumps(resp) + "\n")
+                                     .encode("utf-8"))
+                    self.wfile.flush()
+                except OSError:
+                    return                  # client went away mid-reply
+            finally:
+                state.handler_exit()
             if resp.get("shutting_down"):
+                return
+            if state.shutdown_event.is_set():
+                # graceful drain: the reply for every request read
+                # so far is on the wire; stop reading new ones and
+                # close, so the client sees EOF, not a hang
                 return
 
 
@@ -354,6 +443,7 @@ class _Watcher:
         self.compile_kwargs = dict(compile_kwargs)
         self.warmup_rows = warmup_rows
         self._last_key = current_key
+        self._failed_key: Optional[Tuple[str, float, int]] = None
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name="lightgbm-tpu-serve-watcher")
@@ -376,12 +466,19 @@ class _Watcher:
             key = _artifact_key(path)
         except OSError:
             return False
-        # self._last_key is only touched on this thread (and the
-        # constructor, which runs before it starts)
+        # self._last_key/_failed_key are only touched on this thread
+        # (and the constructor, which runs before it starts)
         if key == self._last_key:
             return False
-        self._last_key = key
         try:
+            # manifest validation first (resilience/publisher.py): a
+            # managed artifact whose bytes mismatch its manifest is a
+            # TORN publication — a publisher died between its manifest
+            # and model writes, or a non-atomic writer is mid-way —
+            # and must be skipped, not served. Unmanaged artifacts
+            # (no sidecar) keep the legacy trust-once-it-parses path.
+            from ..resilience.publisher import validate_artifact
+            manifest = validate_artifact(path)
             booster = _load_booster(path)
             from .compile import compile_forest
             old = self.state.batcher._current_forest()
@@ -413,16 +510,30 @@ class _Watcher:
                 else:
                     raise
         except Exception as e:
-            # a half-trained/corrupt artifact must never take down the
-            # old model; atomic writers make this rare, not impossible
-            log_warning(f"serve: hot swap from {path!r} failed ({e}); "
-                        "keeping the current model")
+            # a torn/half-trained/corrupt artifact must never take
+            # down the old model, OR poison the watcher: _last_key is
+            # left unadvanced so the NEXT poll retries — a mid-write
+            # file's atomic replacement lands momentarily. The fault
+            # event and the warning fire once per observed key (the
+            # counter still counts every failed attempt).
+            first_sighting = key != self._failed_key
+            self._failed_key = key
+            if first_sighting:
+                log_warning(f"serve: hot swap from {path!r} failed "
+                            f"({e}); keeping the current model and "
+                            "retrying next poll")
+                from ..resilience.faults import record_fault_event
+                record_fault_event(
+                    "swap_failure", action="retry_next_poll",
+                    detail=f"hot swap from {path} failed: {e}")
             self.state.note_swap_failure()
             return False
+        self._last_key = key
+        self._failed_key = None
         # identity updates the moment the new model SERVES; warmup is
         # an optimization and its failure is not a failed swap (the
         # buckets just compile lazily on traffic)
-        self.state.note_swap(forest.model_id, path)
+        self.state.note_swap(forest.model_id, path, manifest=manifest)
         log_info(f"serve: hot-swapped model from {path} "
                  f"(id {forest.model_id})")
         if self.warmup_rows != 0:
@@ -517,6 +628,22 @@ def build_parser() -> argparse.ArgumentParser:
                    default=Config.serve_queue_rows,
                    help="pending-row budget before submits are "
                         "rejected (backpressure)")
+    p.add_argument("--shed-queue-rows", type=int,
+                   default=Config.serve_shed_queue_rows,
+                   help="soft backlog threshold: above it the batcher "
+                        "sheds its OLDEST queued requests with a "
+                        "typed {\"shed\": true} reply (0 = disabled)")
+    p.add_argument("--shed-p99-ms", type=float,
+                   default=Config.serve_shed_p99_ms,
+                   help="per-request latency budget: a request that "
+                        "already waited longer is shed at dequeue "
+                        "time (0 = disabled)")
+    p.add_argument("--grace", type=float,
+                   default=Config.serve_shutdown_grace_sec,
+                   help="graceful-shutdown deadline in seconds: on "
+                        "SIGTERM / the shutdown command the daemon "
+                        "drains already-accepted requests for up to "
+                        "this long before closing")
     p.add_argument("--warmup-rows", type=int, default=None,
                    help="pre-compile buckets up to this many rows at "
                         "startup (default: all buckets; 0 disables)")
@@ -571,6 +698,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         # identical content on the first poll, while load-then-stat
         # would suppress a legitimate first swap forever
         watch_key = _artifact_key(model_path)
+        # a managed artifact (publisher manifest sidecar) is validated
+        # at startup exactly like at swap time: serving a torn
+        # publication is wrong on boot too, and the exit lets the
+        # fleet supervisor retry once the publisher's retry lands
+        from ..resilience.publisher import validate_artifact
+        manifest = validate_artifact(model_path)
         booster = _load_booster(model_path)
         from .batcher import MicroBatcher
         from .compile import compile_forest
@@ -585,13 +718,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         # must exit with the documented [Fatal] line, not a traceback
         batcher = MicroBatcher(forest, batch_window_ms=args.window_ms,
                                max_batch_rows=args.max_batch_rows,
-                               queue_max_rows=args.queue_rows)
+                               queue_max_rows=args.queue_rows,
+                               shed_queue_rows=args.shed_queue_rows,
+                               shed_p99_ms=args.shed_p99_ms)
     except Exception as e:
         print(f"[LightGBM-TPU] [Fatal] cannot serve {model_path!r}: "
               f"{e}", file=sys.stderr)
         return 1
     state = ServeState(batcher, forest.model_id, model_path,
-                       telemetry_path=telemetry_path)
+                       telemetry_path=telemetry_path,
+                       manifest=manifest)
     try:
         server = _Server((args.host, port), _Handler)
     except OSError as e:
@@ -619,12 +755,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                                      daemon=True,
                                      name="lightgbm-tpu-serve-accept")
     server_thread.start()
+    # a supervised restart is a SIGTERM, not a SIGKILL: treat it as a
+    # graceful-shutdown request so the drain below still runs and no
+    # accepted request is dropped (docs/SERVING.md "Shutdown")
+    import signal as _signal
+    try:
+        _signal.signal(_signal.SIGTERM,
+                       lambda *_: state.request_shutdown())
+    except ValueError:
+        pass      # not the main thread (embedded use): skip the hook
     try:
         state.shutdown_event.wait()
     except KeyboardInterrupt:
         pass
+    # ---- graceful drain (bounded by --grace) ----
+    # order matters: stop ACCEPTING first, then drain what was already
+    # accepted, then wait for handler threads to put the replies on
+    # the wire — only then close the socket. A request the daemon
+    # accepted is answered or the client sees the connection close;
+    # it is never silently dropped by a supervised restart.
+    deadline = time.monotonic() + max(0.0, float(args.grace))
+    server.shutdown()                        # no new connections
+    state.batcher.close(
+        timeout=max(0.1, deadline - time.monotonic()))
+    while state.active_handlers() > 0 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    dropped = state.active_handlers()
+    if dropped:
+        log_warning(f"serve: {dropped} connection handler(s) still "
+                    "busy at the shutdown grace deadline")
     state.emit_serve_event()                 # final snapshot
-    server.shutdown()
     server.server_close()
     state.close()
     log_info("serve: shut down cleanly")

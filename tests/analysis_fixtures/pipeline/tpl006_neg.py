@@ -1,0 +1,15 @@
+# tpulint fixture: TPL006 negative — the same generation-scoring
+# helper with the dispatch outside the lock; only pure-python
+# bookkeeping runs under it. No EXPECT lines.
+import threading
+
+import jax.numpy as jnp
+
+_lock = threading.Lock()
+_summary = {"auc_sum": 0.0}
+
+
+def record_generation_auc(scores):
+    auc = float(jnp.mean(scores))     # dispatch FIRST, lock-free
+    with _lock:
+        _summary["auc_sum"] += auc

@@ -1,0 +1,18 @@
+# tpulint fixture: TPL006 positive — the lifecycle supervisor holding
+# its stats lock across a jax dispatch (a pipeline step that scores
+# the freshly published model while a loadgen thread wants the lock
+# for its own bookkeeping: one slow device call stalls every request
+# outcome record).
+import threading
+
+import jax.numpy as jnp
+
+_lock = threading.Lock()
+_summary = {"auc_sum": 0.0}
+
+
+def record_generation_auc(scores):
+    with _lock:
+        # EXPECT: TPL006
+        auc = jnp.mean(scores)        # dispatch while holding _lock
+        _summary["auc_sum"] += float(auc)

@@ -1,0 +1,43 @@
+# tpulint fixture: TPL008 negative — the same lifecycle load
+# generator as pipeline/tpl008_pos.py with every worker/supervisor-
+# shared field guarded by one common lock, and the blocking socket
+# work outside it. No EXPECT lines.
+import threading
+
+_published = []
+_published_lock = threading.Lock()
+
+
+class LoadGenerator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.attempts = 0
+        self.ok = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _send_request(self):
+        return True                   # stands in for socket I/O
+
+    def _run(self):
+        while True:
+            got = self._send_request()   # blocking work OUTSIDE
+            with self._lock:
+                self.attempts += 1
+                if got:
+                    self.ok += 1
+
+    def snapshot(self):
+        with self._lock:
+            return {"attempts": self.attempts, "ok": self.ok}
+
+
+def _poll_publications():
+    with _published_lock:
+        _published.append("model.txt")
+
+
+def watch_publications():
+    threading.Thread(target=_poll_publications).start()
+    with _published_lock:
+        return list(_published)

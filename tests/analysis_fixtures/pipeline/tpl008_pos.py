@@ -1,0 +1,36 @@
+# tpulint fixture: TPL008 positive — a lifecycle load generator whose
+# worker thread mutates outcome stats no lock guards. This is the
+# "strip the lock from pipeline.py's LoadGenerator" acceptance shape:
+# pipeline/tpl008_neg.py is the same generator WITH the common lock,
+# and stripping the real one must re-surface these findings.
+import threading
+
+_published = []     # module-global publish book the poller mutates
+
+
+class LoadGenerator:
+    def __init__(self):
+        self.attempts = 0
+        self.ok = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            # EXPECT: TPL008
+            self.attempts += 1
+            # EXPECT: TPL008
+            self.ok += 1
+
+    def snapshot(self):
+        return {"attempts": self.attempts, "ok": self.ok}
+
+
+def _poll_publications():
+    # EXPECT: TPL008
+    _published.append("model.txt")
+
+
+def watch_publications():
+    threading.Thread(target=_poll_publications).start()
+    return list(_published)

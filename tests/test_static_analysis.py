@@ -183,6 +183,8 @@ _FIXTURES = [
     "obs/tpl008_pos.py", "obs/tpl008_neg.py",
     "obs/tpl008_pragma.py",
     "serve/tpl008_pos.py", "serve/tpl008_neg.py",
+    "pipeline/tpl006_pos.py", "pipeline/tpl006_neg.py",
+    "pipeline/tpl008_pos.py", "pipeline/tpl008_neg.py",
     "tpl009_pos.py", "tpl009_neg.py",
     "tpl010_pos.py", "tpl010_neg.py",
     "tpl010_comms_pos.py", "tpl010_comms_neg.py",
@@ -557,6 +559,39 @@ def test_stripping_the_batcher_lock_fails(tmp_path):
     fids = [f.fid for f in res.findings]
     assert ("TPL008:serve/batcher.py:MicroBatcher._run_batch:"
             "shared:self._pending_rows#1") in fids, fids
+
+
+def test_stripping_the_loadgen_lock_fails(tmp_path):
+    """Lifecycle acceptance mutation (ISSUE 13): strip the lock around
+    the pipeline load generator's outcome bookkeeping
+    (pipeline.py LoadGenerator._note) -> TPL008 names the shared
+    counters the supervisor's snapshot() reads concurrently."""
+    anchor = ("        now = time.monotonic()\n"
+              "        with self._lock:\n"
+              "            self._counts[\"attempts\"] += 1")
+    res = _lint_mutated(
+        "pipeline.py",
+        lambda src: src.replace(
+            anchor,
+            "        now = time.monotonic()\n"
+            "        if True:\n"
+            "            self._counts[\"attempts\"] += 1"),
+        ["TPL008"], tmp_path)
+    fids = [f.fid for f in res.findings]
+    assert ("TPL008:pipeline.py:LoadGenerator._note:"
+            "shared:self._counts#1") in fids, fids
+    assert ("TPL008:pipeline.py:LoadGenerator._note:"
+            "shared:self._latencies#1") in fids, fids
+
+
+def test_pipeline_and_publisher_are_thread_clean():
+    """The shipped lifecycle modules (pipeline.py, the publisher under
+    resilience/) lint clean for the thread/lock rules."""
+    res = run_lint(root=PKG, rules=["TPL006", "TPL008"],
+                   baseline_path=BASELINE,
+                   files=["pipeline.py", "resilience/publisher.py",
+                          "resilience/elastic.py"])
+    assert not res.findings, [f.fid for f in res.findings]
 
 
 def test_grow_collective_conds_are_justified():
