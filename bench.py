@@ -547,6 +547,19 @@ def main():
                    for label, v in top_phases},
         "hbm": lgb_obs.device_memory_stats(),
     }
+    # in-band XLA cost attribution (obs/cost.py; docs/ROOFLINE.md):
+    # every first compile per signature recorded flops/bytes and the
+    # cost-model-optimal ms at the device peaks, so each bench run
+    # carries its own roofline denominators
+    try:
+        from lightgbm_tpu.obs.cost import drain_compile_events
+        result["telemetry"]["xla_cost"] = [
+            {k: ev.get(k) for k in ("entry", "flops",
+                                    "bytes_accessed", "wall_ms",
+                                    "optimal_ms", "device_kind")}
+            for ev in drain_compile_events()]
+    except Exception:
+        result["telemetry"]["xla_cost"] = []
     if _SERVE:
         result["serve"] = _serve_bench(bst, lgb_obs, N_FEATURES)
     if result_auc is not None:

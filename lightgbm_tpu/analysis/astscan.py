@@ -145,6 +145,11 @@ class ModuleScan:
         self.tree = ast.parse(source, filename=relpath)
         self.lines = source.splitlines()
         self.funcs: Dict[str, FuncInfo] = {}
+        # class name -> base-class dotted names (raw, unresolved):
+        # TPL008 seeds socketserver/http.server request-handler
+        # subclasses as thread-side (their do_*/handle methods run on
+        # the serving stack's daemon threads, not the main path)
+        self.class_bases: Dict[str, Tuple[str, ...]] = {}
         self.imports: Dict[str, str] = {}       # module-level aliases
         # module-level name -> ("func", qual) | ("wrapper", qual, JitWrap)
         self.aliases: Dict[str, tuple] = {}
@@ -209,6 +214,8 @@ class ModuleScan:
                  parent_qual: Optional[str]) -> None:
         for child in ast.iter_child_nodes(node):
             if isinstance(child, ast.ClassDef):
+                self.class_bases[child.name] = tuple(
+                    dotted_of(b) or "" for b in child.bases)
                 self._collect(child, quals + [child.name],
                               classes + [child.name], parent_qual)
             elif isinstance(child, (ast.FunctionDef,

@@ -252,6 +252,13 @@ def rank_tainted_returns(graph: CallGraph) -> Set[str]:
 # ---------------------------------------------------------------------
 
 _THREAD_CTORS = {"Thread", "Timer"}
+
+#: request-handler base classes whose methods run on serving-stack
+#: threads (socketserver.ThreadingMixIn servers spawn one per
+#: connection; http.server.ThreadingHTTPServer likewise)
+_HANDLER_BASES = {"BaseRequestHandler", "StreamRequestHandler",
+                  "DatagramRequestHandler", "BaseHTTPRequestHandler",
+                  "SimpleHTTPRequestHandler", "CGIHTTPRequestHandler"}
 #: package-specific: watchdog.guarded(name, fn, ...) runs fn on a fresh
 #: daemon worker thread (resilience/watchdog.py)
 _GUARDED_BASENAMES = {"guarded"}
@@ -297,9 +304,26 @@ def thread_side_functions(graph: CallGraph) -> Dict[Key, Tuple[str, int]]:
     """Every function that runs on a spawned thread, mapped to
     ``(how, seed lineno)`` where ``how`` names the spawn site
     (``threading.Thread`` / ``threading.Timer`` /
-    ``watchdog.guarded``). Seeds are closed transitively over the call
-    graph: helpers called from thread-side code are thread-side."""
+    ``watchdog.guarded``). Methods of socketserver / http.server
+    request-handler subclasses are seeded too: the serving stack
+    (ThreadingTCPServer, ThreadingHTTPServer — the serve daemon's
+    protocol handler, the /metrics scrape endpoint in obs/export.py)
+    invokes ``do_*``/``handle`` on per-connection daemon threads the
+    call graph cannot otherwise see. Seeds are closed transitively
+    over the call graph: helpers called from thread-side code are
+    thread-side."""
     seeds: Dict[Key, Tuple[str, int]] = {}
+    for relpath, scan in graph.scans.items():
+        handler_classes = {
+            cls for cls, bases in scan.class_bases.items()
+            if any(base.rsplit(".", 1)[-1] in _HANDLER_BASES
+                   for base in bases)}
+        if not handler_classes:
+            continue
+        for info in scan.funcs.values():
+            if info.class_name in handler_classes:
+                seeds.setdefault(
+                    info.key, ("request-handler thread", info.lineno))
     for scope, facts in graph.facts.items():
         for rec in facts.records:
             if rec.node is None:

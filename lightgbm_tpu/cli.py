@@ -9,6 +9,7 @@ Usage:
     python -m lightgbm_tpu config=train.conf [key=value ...]
     python -m lightgbm_tpu task=train data=train.csv objective=binary
     python -m lightgbm_tpu stats run.jsonl     # summarize telemetry
+    python -m lightgbm_tpu stats telemetry/ --fleet   # merged fleet view
     python -m lightgbm_tpu checkpoints <dir>   # inspect snapshots
     python -m lightgbm_tpu lint [--help]       # tpulint static analyzer
     python -m lightgbm_tpu launch 4 -- <cmd>   # elastic restart supervisor
@@ -177,18 +178,26 @@ def _task_save_binary(cfg: Config, params: Dict[str, Any]) -> None:
 
 
 _STATS_HELP = """\
-usage: python -m lightgbm_tpu stats <file.jsonl>
+usage: python -m lightgbm_tpu stats <file.jsonl | dir> [--fleet]
 
 Fold a telemetry event stream (lightgbm_tpu.telemetry(path) callback /
 LIGHTGBM_TPU_TELEMETRY=<path>) into the sorted per-phase summary table:
 wall time, recompiles, peak HBM, fault events, final evals, a serve
 summary row when the file carries {"event": "serve"} daemon lines
-(docs/SERVING.md), and a per-phase total/count/mean/percent/skew
+(docs/SERVING.md), an xla cost section when it carries
+{"event": "compile"} records (flops / bytes / live roofline,
+docs/ROOFLINE.md), and a per-phase total/count/mean/percent/skew
 breakdown. See docs/OBSERVABILITY.md.
+
+A DIRECTORY summarizes every *.jsonl file inside (recursively, .rankN
+suffixes included) with per-file provenance headers — the fleet's
+telemetry/ directory is the expected shape. --fleet appends the
+merged cross-process view: trainer iteration/compile totals, summed
+serve traffic with worst-case p99, shed and restart totals.
 
 exit codes:
   0  summary printed
-  1  unreadable/malformed file, or no iteration/serve events in it
+  1  unreadable/malformed input, or no known events in it
 """
 
 _CHECKPOINTS_HELP = """\
@@ -204,19 +213,57 @@ exit codes:
 """
 
 
+def _summary_has_events(summary: Dict[str, Any]) -> bool:
+    return bool(summary["iterations"] or summary.get("serve")
+                or summary.get("publishes")
+                or summary.get("compiles")
+                or summary.get("fleet_events"))
+
+
 def _task_stats(argv: List[str]) -> int:
-    """``lightgbm_tpu stats <file.jsonl>``: fold a telemetry event
-    stream (callback.telemetry / LIGHTGBM_TPU_TELEMETRY) into the
-    sorted per-phase summary table."""
+    """``lightgbm_tpu stats <file.jsonl | dir> [--fleet]``: fold one
+    telemetry event stream — or a directory of them, one per fleet
+    process — into the sorted summary tables; ``--fleet`` appends the
+    merged cross-process view."""
     if argv and argv[0] in ("-h", "--help"):
         print(_STATS_HELP)
         return 0
+    fleet = "--fleet" in argv
+    argv = [a for a in argv if a != "--fleet"]
     if not argv:
-        print("usage: python -m lightgbm_tpu stats <file.jsonl>",
-              file=sys.stderr)
+        print("usage: python -m lightgbm_tpu stats "
+              "<file.jsonl | dir> [--fleet]", file=sys.stderr)
         return 1
     from .obs import render_stats_table, summarize_events
     path = argv[0]
+    if os.path.isdir(path):
+        from .obs import (merge_fleet_summaries, render_fleet_table,
+                          summarize_directory)
+        try:
+            entries = summarize_directory(path)
+        except OSError as e:
+            print(f"[LightGBM-TPU] [Fatal] cannot read {path}: {e}",
+                  file=sys.stderr)
+            return 1
+        except (ValueError, TypeError, AttributeError, KeyError) as e:
+            print(f"[LightGBM-TPU] [Fatal] malformed telemetry under "
+                  f"{path}: {e}", file=sys.stderr)
+            return 1
+        useful = [(rel, s) for rel, s in entries
+                  if _summary_has_events(s)]
+        if not useful:
+            print(f"no telemetry events in any *.jsonl under {path}",
+                  file=sys.stderr)
+            return 1
+        blocks = []
+        for rel, summary in useful:
+            blocks.append(f"== {rel} ==\n"
+                          + render_stats_table(summary))
+        if fleet:
+            blocks.append(render_fleet_table(
+                merge_fleet_summaries(useful)))
+        print("\n\n".join(blocks))
+        return 0
     try:
         summary = summarize_events(path)
     except OSError as e:
@@ -228,12 +275,18 @@ def _task_stats(argv: List[str]) -> int:
         print(f"[LightGBM-TPU] [Fatal] malformed telemetry in {path}: "
               f"{e}", file=sys.stderr)
         return 1
-    if summary["iterations"] == 0 and not summary.get("serve") \
-            and not summary.get("publishes"):
+    if not _summary_has_events(summary):
         print(f"no iteration, serve or publish events in {path}",
               file=sys.stderr)
         return 1
     print(render_stats_table(summary))
+    if fleet:
+        # --fleet on a single stream: the one-entry merged view (so
+        # the flag is never silently ignored in scripts)
+        from .obs import merge_fleet_summaries, render_fleet_table
+        print()
+        print(render_fleet_table(merge_fleet_summaries(
+            [(os.path.basename(path), summary)])))
     return 0
 
 

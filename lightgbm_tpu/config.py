@@ -522,6 +522,21 @@ class Config:
     # supervised restart never drops an accepted request
     serve_shutdown_grace_sec: float = 15.0
 
+    # ---- observability (lightgbm_tpu/obs/; docs/OBSERVABILITY.md) ----
+    # base port of the OpenMetrics /metrics HTTP endpoint
+    # (obs/export.py): every process of a fleet exports its
+    # MetricsRegistry at metrics_port + its rank (trainer ranks under
+    # `launch`, serve replicas via `serve --metrics-port`, the
+    # supervisors at the base port). 0 (default) disables the
+    # endpoint; the LIGHTGBM_TPU_METRICS_PORT env var (exported by
+    # the supervisors) overrides
+    metrics_port: int = 0
+    # seconds between fleet metric scrapes: the cadence at which the
+    # `launch` fleet supervisor and the `pipeline` driver poll their
+    # children's stats into {"event": "fleet"} telemetry records
+    # (docs/OBSERVABILITY.md "Fleet events"). 0 disables scraping
+    metrics_scrape_interval_sec: float = 5.0
+
     # ---- publish (resilience/publisher.py; docs/PIPELINE.md) ----
     # retry budget for one atomic model publication into the serve
     # watch directory (transient failures: full disk, slow rename,
@@ -712,6 +727,8 @@ class Config:
         "serve_shutdown_grace_sec": (0.0, None),
         "publish_retries": (0, None),
         "publish_backoff_sec": (0.0, None),
+        "metrics_port": (0, 65535),
+        "metrics_scrape_interval_sec": (0.0, None),
         "metric_freq": (1, None),
         "multi_error_top_k": (1, None),
     }

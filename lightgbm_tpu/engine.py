@@ -22,6 +22,44 @@ from .utils.log import log_info, log_warning, scoped_verbosity
 from .utils.timer import Timer, timed
 
 
+def _setup_metrics_endpoint(cfg: Config) -> None:
+    """Start the per-process OpenMetrics /metrics endpoint
+    (obs/export.py) when ``metrics_port`` is configured — via params
+    or the LIGHTGBM_TPU_METRICS_PORT env var the fleet supervisors
+    export. Each rank binds base + rank so a multi-process world's
+    endpoints never collide; idempotent per process (cv folds and the
+    pipeline's generations reuse the first server)."""
+    # the env var OVERRIDES the param (config.py's documented
+    # precedence): under a supervisor the exported base must win, or a
+    # params-level metrics_port would collide with the supervisor's
+    # own endpoint and desync the rank -> port attribution its
+    # world-shape scraper relies on
+    port = cfg.metrics_port
+    env_port = os.environ.get("LIGHTGBM_TPU_METRICS_PORT")
+    if env_port:
+        try:
+            port = int(env_port)
+        except ValueError:
+            pass
+    if not port:
+        return
+    rank = 0
+    rank_env = os.environ.get("LIGHTGBM_TPU_RANK")
+    if rank_env:
+        try:
+            rank = int(rank_env)
+        except ValueError:
+            rank = 0
+    else:
+        try:
+            import jax
+            rank = jax.process_index()
+        except Exception:
+            rank = 0
+    from .obs.export import ensure_metrics_server
+    ensure_metrics_server(port + rank)
+
+
 def _setup_telemetry(callbacks: List[Callable], model) -> None:
     """Activate run telemetry: honor ``LIGHTGBM_TPU_TELEMETRY=<path>``
     unless a telemetry callback is already present, then bind every
@@ -137,6 +175,7 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
     if not isinstance(train_set, Dataset):
         raise TypeError("train() only accepts Dataset object(s)")
 
+    _setup_metrics_endpoint(cfg)
     booster = Booster(params=params, train_set=train_set)
 
     # -- crash recovery (resilience/checkpoint.py): an explicit

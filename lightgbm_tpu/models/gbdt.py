@@ -269,10 +269,15 @@ def _linear_eval(const, coef, feats, nfeat, leaf_value, raw, leaves):
 
 
 # recompile telemetry (obs/jit_tracker.py): a cache miss on any of these
-# mid-training is the 530 ms/iter regression class from PROFILE.md
-register_jit("gbdt/tree_values_binned", _tree_values_binned)
-register_jit("gbdt/tree_leaves_binned", _tree_leaves_binned)
-register_jit("gbdt/linear_eval", _linear_eval)
+# mid-training is the 530 ms/iter regression class from PROFILE.md.
+# Rebinding routes calls through the cost-attribution wrapper
+# (obs/cost.py: one {"event": "compile"} record per first compile per
+# signature)
+_tree_values_binned = register_jit("gbdt/tree_values_binned",
+                                   _tree_values_binned)
+_tree_leaves_binned = register_jit("gbdt/tree_leaves_binned",
+                                   _tree_leaves_binned)
+_linear_eval = register_jit("gbdt/linear_eval", _linear_eval)
 
 
 class _ValidData:

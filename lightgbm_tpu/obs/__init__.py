@@ -18,15 +18,30 @@ is a strict no-op when disabled:
   (phase wall times, recompiles, HBM, tree stats, eval results),
   activated by ``lightgbm_tpu.callback.telemetry(path)`` or the
   ``LIGHTGBM_TPU_TELEMETRY=<path>`` env var.
+- :mod:`~lightgbm_tpu.obs.export` — the fleet metrics plane: the
+  registry rendered as OpenMetrics text on a jax-free stdlib
+  ``/metrics`` endpoint (``metrics_port`` / ``--metrics-port``,
+  port + rank per process) and the strict parser the fleet scrapers
+  and tests read it back with.
+- :mod:`~lightgbm_tpu.obs.cost` — in-band XLA cost attribution: each
+  registered entry point's first compile per signature records
+  flops / bytes / compile wall / cost-model-optimal ms as
+  ``{"event": "compile"}`` telemetry (docs/ROOFLINE.md made live).
 
 See docs/OBSERVABILITY.md for the event schema and workflow.
 """
 
+from .cost import (CostTracked, compile_events_snapshot, device_peaks,
+                   drain_compile_events, roofline_optimal_ms)
+from .export import (MetricsHTTPServer, ensure_metrics_server,
+                     parse_openmetrics, render_openmetrics)
 from .jit_tracker import (RecompileWatcher, jit_cache_sizes, register_jit,
                           total_recompiles)
 from .memory import device_memory_stats
 from .recorder import (ITERATION_EVENT_KEYS, TelemetryRecorder,
-                       render_stats_table, summarize_events)
+                       merge_fleet_summaries, render_fleet_table,
+                       render_stats_table, summarize_directory,
+                       summarize_events)
 from .registry import Counter, Gauge, Histogram, MetricsRegistry, registry
 
 __all__ = [
@@ -35,4 +50,10 @@ __all__ = [
     "RecompileWatcher", "device_memory_stats",
     "TelemetryRecorder", "ITERATION_EVENT_KEYS",
     "summarize_events", "render_stats_table",
+    "summarize_directory", "merge_fleet_summaries",
+    "render_fleet_table",
+    "render_openmetrics", "parse_openmetrics", "MetricsHTTPServer",
+    "ensure_metrics_server",
+    "CostTracked", "drain_compile_events", "compile_events_snapshot",
+    "device_peaks", "roofline_optimal_ms",
 ]

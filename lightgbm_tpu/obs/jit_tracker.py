@@ -14,7 +14,20 @@ rebuilding an entry point (the fused step is re-jitted after
 whole cache size counts as fresh compiles, so replacement never hides
 work behind a shrinking counter — and a recycled object address
 (``id()`` reuse after GC) can never alias a new function onto a dead
-entry.
+entry. Entries hold their callables by WEAKREF and retire once the
+callable is collected (the OOM ladder's jit rebuilds and the engine's
+``_scan_fns`` resets would otherwise leave dead functions' last cache
+sizes in ``jit_cache_sizes()``/``total_recompiles()`` forever —
+tests/test_metrics_export.py pins the rebuild-then-count behavior).
+
+Since the fleet-metrics PR, ``register_jit`` additionally wraps each
+entry point in :class:`~lightgbm_tpu.obs.cost.CostTracked` (XLA cost
+attribution: one ``{"event": "compile"}`` record with flops/bytes per
+first compile per signature; LIGHTGBM_TPU_COST_ATTRIBUTION=0
+disables). Definition sites therefore REBIND the registered name —
+``fn = register_jit("x", fn)`` — so calls route through the wrapper;
+the wrapper proxies ``_cache_size`` and the AOT surface, so this
+module's polling is unchanged.
 """
 
 from __future__ import annotations
@@ -34,22 +47,31 @@ _seq = 0
 
 
 def register_jit(name: str, fn: Callable) -> Callable:
-    """Track a jitted callable's compile cache; returns ``fn`` so it can
-    wrap a definition site. Non-jitted callables (no ``_cache_size``)
-    are accepted and ignored — callers never need to branch.
-    Re-registering the same live object under the same name is a
-    no-op."""
+    """Track a jitted callable's compile cache and wrap it for XLA
+    cost attribution; returns the (wrapped) callable, so definition
+    sites rebind: ``fn = register_jit("name", fn)``. Non-jitted
+    callables (no ``_cache_size``) are accepted and returned
+    unchanged — callers never need to branch. Re-registering the same
+    live object (or its already-registered wrapper) under the same
+    name returns the existing wrapper, never a duplicate entry."""
     global _seq
     if not hasattr(fn, "_cache_size"):
         return fn
+    from .cost import CostTracked, cost_wrap_enabled
+    with _lock:
+        for (tracked_name, _), r in _tracked.items():
+            if tracked_name != name:
+                continue
+            live = r()
+            if live is fn or getattr(live, "unwrapped", None) is fn:
+                return live
+    if cost_wrap_enabled() and not isinstance(fn, CostTracked):
+        fn = CostTracked(name, fn)
     try:
         ref = weakref.ref(fn)
     except TypeError:  # not weakref-able; keep a strong closure
         ref = (lambda f: (lambda: f))(fn)
     with _lock:
-        for (tracked_name, _), r in _tracked.items():
-            if tracked_name == name and r() is fn:
-                return fn
         _seq += 1
         _tracked[(name, _seq)] = ref
     return fn

@@ -23,8 +23,9 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .jit_tracker import RecompileWatcher
 from .memory import device_memory_stats
@@ -32,7 +33,9 @@ from .registry import MetricsRegistry
 from .registry import registry as _global_registry
 
 __all__ = ["TelemetryRecorder", "ITERATION_EVENT_KEYS",
-           "summarize_events", "render_stats_table"]
+           "summarize_events", "render_stats_table", "ENTRY_PHASES",
+           "summarize_directory", "merge_fleet_summaries",
+           "render_fleet_table"]
 
 #: required keys of every iteration event (the JSONL schema contract).
 #: ``comm`` is the collective-payload record of distributed training
@@ -147,6 +150,7 @@ class TelemetryRecorder:
         half-open on the abort path."""
         try:
             self._drain_fault_events()
+            self._drain_compile_events()
         finally:
             try:
                 if self._file is not None:
@@ -260,6 +264,20 @@ class TelemetryRecorder:
                 pass
             self._file = None
 
+    def _drain_compile_events(self) -> None:
+        """Move pending XLA compile records (obs/cost.py: flops/bytes
+        cost attribution captured at each entry point's first compile
+        per signature) into the JSONL stream. Drained through the same
+        locked snapshot-and-clear contract as fault events — a compile
+        landing from the batcher thread between a copy and a clear
+        must not be lost."""
+        try:
+            from .cost import drain_compile_events
+        except Exception:
+            return
+        for ev in drain_compile_events():
+            self._write_line(ev)
+
     def _drain_fault_events(self) -> None:
         """Move fault events (non-finite guard trips, OOM downgrades;
         models/gbdt.py ``fault_log``) into the JSONL stream, plus the
@@ -325,6 +343,7 @@ class TelemetryRecorder:
         }
         self._feed_registry(event)
         self._drain_fault_events()  # fault lines precede their iteration
+        self._drain_compile_events()  # so do the compiles they ran under
         self._write_line(event)
         self.events_written += 1
         return event
@@ -405,6 +424,9 @@ def summarize_events(path: str) -> dict:
     comm_last: Optional[Dict[str, object]] = None
     scan_windows = 0
     scan_iterations = 0
+    compiles: Dict[str, Dict[str, object]] = {}
+    fleet_events = 0
+    fleet: Optional[Dict[str, object]] = None
 
     def _parse(line: str, is_last: bool) -> Optional[dict]:
         try:
@@ -445,6 +467,30 @@ def summarize_events(path: str) -> dict:
             # (resilience/publisher.py; docs/PIPELINE.md)
             publishes += 1
             publish = {k: v for k, v in ev.items() if k != "event"}
+            continue
+        if ev.get("event") == "compile":
+            # XLA cost attribution (obs/cost.py): fold per entry point
+            # — totals accumulate, the cost-model numbers keep the
+            # newest signature's values (re-compiles of one entry are
+            # usually shape growth, and the latest shape is the one
+            # the phase table measured)
+            entry = str(ev.get("entry", "?"))
+            slot = compiles.setdefault(
+                entry, {"compiles": 0, "wall_ms_total": 0.0,
+                        "flops": None, "bytes_accessed": None,
+                        "optimal_ms": None, "device_kind": None})
+            slot["compiles"] += int(ev.get("compiles", 1) or 1)
+            slot["wall_ms_total"] += float(ev.get("wall_ms") or 0.0)
+            for key in ("flops", "bytes_accessed", "optimal_ms",
+                        "device_kind"):
+                if ev.get(key) is not None:
+                    slot[key] = ev[key]
+            continue
+        if ev.get("event") == "fleet":
+            # fleet scrape lines carry the supervisor's whole view;
+            # the newest one IS the summary
+            fleet_events += 1
+            fleet = {k: v for k, v in ev.items() if k != "event"}
             continue
         if ev.get("event") != "iteration":
             continue
@@ -494,7 +540,58 @@ def summarize_events(path: str) -> dict:
             "comm_post_reduction_bytes": comm_post_bytes,
             "comm": comm_last,
             "scan_windows": scan_windows,
-            "scan_iterations": scan_iterations}
+            "scan_iterations": scan_iterations,
+            "compiles": compiles,
+            "fleet": fleet, "fleet_events": fleet_events}
+
+
+#: jit entry point -> Timer phase whose per-call mean is the measured
+#: counterpart of the entry's cost-model-optimal ms (the live roofline
+#: of docs/ROOFLINE.md). Entries without a phase (predict paths) still
+#: list their cost numbers, just without a measured column.
+ENTRY_PHASES = {
+    "gbdt/fused_iter": "boosting/fused_iter",
+    "gbdt/fused_scan": "boosting/fused_scan",
+    "ops/grow_tree": "tree_learner/grow",
+    "parallel/dp_grow": "tree_learner/grow",
+    "ranking/lambdarank_grads": "boosting/gradients",
+}
+
+
+def _render_compiles(summary: dict, lines: list) -> None:
+    """The ``xla cost`` section: per-entry flops/bytes from the compile
+    events plus the roofline comparison — measured per-call phase ms
+    against the cost-model optimal at the device peaks."""
+    compiles = summary.get("compiles")
+    if not compiles:
+        return
+    phases = summary.get("phases") or {}
+    kinds = {v.get("device_kind") for v in compiles.values()
+             if v.get("device_kind")}
+    lines.append("")
+    lines.append(f"xla cost attribution"
+                 f"{' (' + ', '.join(sorted(kinds)) + ')' if kinds else ''}:")
+    lines.append(f"{'entry':28s} {'compiles':>8s} {'GFLOP':>9s} "
+                 f"{'MiB acc':>9s} {'compile ms':>11s} {'opt ms':>8s} "
+                 f"{'meas ms':>8s} {'roofline':>9s}")
+    for entry, v in sorted(compiles.items()):
+        flops = v.get("flops")
+        nbytes = v.get("bytes_accessed")
+        opt = v.get("optimal_ms")
+        meas = None
+        phase = phases.get(ENTRY_PHASES.get(entry, ""))
+        if phase and phase.get("count"):
+            meas = phase["total"] / phase["count"] * 1e3
+        roof = (f"{100.0 * opt / meas:8.1f}%"
+                if opt is not None and meas else "      n/a")
+        lines.append(
+            f"{entry:28s} {v.get('compiles', 0):8d} "
+            f"{'n/a' if flops is None else '%.3f' % (flops / 1e9):>9s} "
+            f"{'n/a' if nbytes is None else '%.1f' % (nbytes / 2**20):>9s} "
+            f"{v.get('wall_ms_total', 0.0):11.1f} "
+            f"{'n/a' if opt is None else '%.3f' % opt:>8s} "
+            f"{'n/a' if meas is None else '%.3f' % meas:>8s} "
+            f"{roof}")
 
 
 def render_stats_table(summary: dict) -> str:
@@ -549,6 +646,19 @@ def render_stats_table(summary: dict) -> str:
             f"{comm.get('split_search', 'gathered')} search, world "
             f"{comm.get('world', '?')}; post-reduction "
             f"{pb / 2**20:.1f} MiB)")
+    flt = summary.get("fleet")
+    if flt:
+        replicas = flt.get("replicas") or flt.get("ranks") or []
+        alive = sum(1 for r in replicas if r.get("alive", True))
+        extras = ""
+        if flt.get("restarts_total") is not None:
+            extras += f", restarts {flt['restarts_total']}"
+        if flt.get("iteration_skew") is not None:
+            extras += f", iter skew {flt['iteration_skew']}"
+        lines.append(
+            f"fleet                : {alive}/{len(replicas)} "
+            f"{flt.get('shape', 'replicas')} up in "
+            f"{summary.get('fleet_events', 0)} scrape(s){extras}")
     if summary.get("scan_windows"):
         lines.append(
             f"fused scan           : {summary['scan_iterations']} "
@@ -578,4 +688,112 @@ def render_stats_table(summary: dict) -> str:
                 f"{label:34s} {v['total']:10.3f} {cnt:8d} "
                 f"{mean_ms:10.3f} {100 * v['total'] / grand:6.1f} "
                 f"{v['max_skew']:8.3f}")
+    _render_compiles(summary, lines)
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------
+# fleet side: a DIRECTORY of telemetry files (one per process) and the
+# merged cross-process view behind `lightgbm_tpu stats <dir> --fleet`
+# ---------------------------------------------------------------------
+
+#: the stream names the fleet writes: ``x.jsonl`` plus the
+#: per-replica ``x.jsonl.rankN`` and supervisor ``x.jsonl.fleet``
+#: suffixes — and nothing else, so a rotated ``x.jsonl.gz`` or an
+#: editor's ``x.jsonl.swp`` can never abort the whole directory walk
+_STREAM_NAME_RE = re.compile(r"\.jsonl(\.rank\d+|\.fleet)?$")
+
+
+def summarize_directory(directory: str) -> List[Tuple[str, dict]]:
+    """``summarize_events`` over every telemetry stream under
+    ``directory`` (recursive — the pipeline nests telemetry/ per
+    side), sorted by relative path for stable provenance. Files whose
+    events are all unknown kinds still appear (an empty summary keeps
+    the provenance honest); matched-but-unreadable files raise like
+    the single-file path."""
+    out: List[Tuple[str, dict]] = []
+    for root, _dirs, names in sorted(os.walk(directory)):
+        for name in sorted(names):
+            if not _STREAM_NAME_RE.search(name):
+                continue
+            path = os.path.join(root, name)
+            rel = os.path.relpath(path, directory)
+            out.append((rel, summarize_events(path)))
+    return out
+
+
+def merge_fleet_summaries(entries: List[Tuple[str, dict]]) -> dict:
+    """Fold per-process summaries into one fleet view: trainer
+    iteration/compile totals, summed serve traffic with worst-case
+    p99, shed and restart totals — the numbers ROADMAP 3(b)'s
+    autoscaler decides on."""
+    merged = {
+        "files": len(entries),
+        "iterations": 0, "recompiles": 0, "compile_ms": 0.0,
+        "publishes": 0, "faults": 0,
+        "serve_replicas": 0, "requests_total": 0, "rows_total": 0,
+        "shed_total": 0, "swaps_total": 0,
+        "qps": 0.0, "p99_ms_max": None,
+        "restarts_total": 0, "iteration_skew": None,
+    }
+    for _rel, s in entries:
+        merged["iterations"] += int(s.get("iterations") or 0)
+        merged["recompiles"] += int(s.get("recompiles") or 0)
+        for v in (s.get("compiles") or {}).values():
+            merged["compile_ms"] += float(v.get("wall_ms_total") or 0)
+        merged["publishes"] += int(s.get("publishes") or 0)
+        merged["faults"] += sum((s.get("faults") or {}).values())
+        srv = s.get("serve")
+        if srv:
+            merged["serve_replicas"] += 1
+            merged["requests_total"] += int(
+                srv.get("requests_total") or 0)
+            merged["rows_total"] += int(srv.get("rows_total") or 0)
+            merged["shed_total"] += int(srv.get("shed_total") or 0)
+            merged["swaps_total"] += int(srv.get("swaps_total") or 0)
+            merged["qps"] += float(srv.get("qps") or 0.0)
+            p99 = srv.get("p99_ms")
+            if p99 is not None:
+                merged["p99_ms_max"] = max(
+                    merged["p99_ms_max"] or 0.0, float(p99))
+        flt = s.get("fleet")
+        if flt:
+            if flt.get("restarts_total") is not None:
+                merged["restarts_total"] = max(
+                    merged["restarts_total"],
+                    int(flt["restarts_total"]))
+            if flt.get("iteration_skew") is not None:
+                merged["iteration_skew"] = max(
+                    merged["iteration_skew"] or 0,
+                    int(flt["iteration_skew"]))
+    return merged
+
+
+def render_fleet_table(merged: dict) -> str:
+    lines = ["fleet (merged view)"]
+    lines.append(f"files                : {merged['files']}")
+    lines.append(f"iterations           : {merged['iterations']}")
+    lines.append(f"jit recompiles       : {merged['recompiles']}")
+    if merged["compile_ms"]:
+        lines.append(f"compile wall         : "
+                     f"{merged['compile_ms'] / 1e3:.3f} s")
+    lines.append(f"publishes            : {merged['publishes']}")
+    if merged["serve_replicas"]:
+        p99 = merged["p99_ms_max"]
+        lines.append(
+            f"serve fleet          : {merged['serve_replicas']} "
+            f"replica(s), {merged['requests_total']} req / "
+            f"{merged['rows_total']} rows, qps {merged['qps']:g}, "
+            f"worst p99 {'n/a' if p99 is None else '%g ms' % p99}, "
+            f"shed {merged['shed_total']}, swaps "
+            f"{merged['swaps_total']}")
+    extras = []
+    if merged["restarts_total"]:
+        extras.append(f"restarts {merged['restarts_total']}")
+    if merged["iteration_skew"] is not None:
+        extras.append(f"iteration skew {merged['iteration_skew']}")
+    if merged["faults"]:
+        extras.append(f"faults {merged['faults']}")
+    if extras:
+        lines.append(f"health               : {', '.join(extras)}")
     return "\n".join(lines)

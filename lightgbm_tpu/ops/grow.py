@@ -2992,8 +2992,10 @@ def _grow_compact_impl(cfg: GrowConfig,
 
 grow_tree = jax.jit(grow_tree_impl, static_argnames=("cfg",))
 
-# recompile telemetry: growth is the hot path whose silent recompiles
-# telemetry exists to catch (obs/jit_tracker.py)
+# recompile telemetry + XLA cost attribution: growth is the hot path
+# whose silent recompiles telemetry exists to catch (obs/jit_tracker.py);
+# rebinding routes calls through the CostTracked wrapper so each first
+# compile per signature emits a {"event": "compile"} record (obs/cost.py)
 from ..obs import register_jit  # noqa: E402  (after grow_tree exists)
 
-register_jit("ops/grow_tree", grow_tree)
+grow_tree = register_jit("ops/grow_tree", grow_tree)

@@ -298,6 +298,43 @@ class LoadGenerator:
                 pass
 
 
+class _ClientMetrics:
+    """Bridges the load generator's client-side view into the
+    supervisor's /metrics endpoint (obs/export.py extra families).
+
+    The endpoint starts before the load generator exists (the
+    bootstrap generation trains first), so the provider holds a slot
+    the supervisor fills later; the slot is written by the supervisor
+    thread and read by HTTP scrape threads, so both sides go through
+    ``self._lock`` (TPL008). The snapshot itself runs outside the
+    slot lock — the generator locks its own stats."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._loadgen: Optional[LoadGenerator] = None
+
+    def attach(self, loadgen: "LoadGenerator") -> None:
+        with self._lock:
+            self._loadgen = loadgen
+
+    def families(self) -> Dict[str, Any]:
+        with self._lock:
+            loadgen = self._loadgen
+        if loadgen is None:
+            return {}
+        from .obs.export import counter_family, gauge_family
+        snap = loadgen.snapshot()
+        fams: Dict[str, Any] = {}
+        for key in ("attempts", "ok", "shed", "overloaded", "error",
+                    "conn", "timeout"):
+            fams[f"client_{key}"] = counter_family(snap.get(key, 0))
+        for key in ("p50_ms", "p99_ms", "max_ok_gap_s",
+                    "since_last_ok_s"):
+            if snap.get(key) is not None:
+                fams[f"client_{key}"] = gauge_family(snap[key])
+        return fams
+
+
 # ---------------------------------------------------------------------
 # CLI
 # ---------------------------------------------------------------------
@@ -390,6 +427,23 @@ def build_parser() -> argparse.ArgumentParser:
                    default=Config.serve_shed_queue_rows)
     p.add_argument("--shed-p99-ms", type=float,
                    default=Config.serve_shed_p99_ms)
+    p.add_argument("--metrics-port", type=int,
+                   default=Config.metrics_port,
+                   help="base port of the fleet metrics plane "
+                        "(docs/OBSERVABILITY.md): the pipeline "
+                        "supervisor's own jax-free OpenMetrics "
+                        "/metrics (loadgen client view + supervisor "
+                        "counters) binds here, trainer ranks at +1, "
+                        "the fleet supervisor at +2 and serve "
+                        "replicas at +3+rank (0 = disabled)")
+    p.add_argument("--scrape-interval", type=float,
+                   default=Config.metrics_scrape_interval_sec,
+                   help="seconds between fleet scrapes: the fleet "
+                        "supervisor polls per-replica QPS/p99/shed/"
+                        "restarts into {\"event\": \"fleet\"} records "
+                        "(telemetry/serve.jsonl.fleet) and the "
+                        "training supervisor records per-rank "
+                        "iteration skew (0 = disabled)")
     p.add_argument("--fault-inject", default=None,
                    help="chaos spec (default: "
                         "$LIGHTGBM_TPU_FAULT_INJECT)")
@@ -589,7 +643,13 @@ def _train_generation(args, gen: int, dirs: Dict[str, str],
         log_dir=os.path.join(dirs["logs"], f"train_g{gen:04d}"),
         grace=args.grace, env=env,
         max_restarts_per_window=args.max_restarts_per_window,
-        restart_window_sec=args.restart_window)
+        restart_window_sec=args.restart_window,
+        # metrics plane: trainer rank endpoints bind metrics_port+1+r
+        # (supervise exports the env var); its fleet events (per-rank
+        # iteration skew) land next to the generation's telemetry
+        metrics_port=args.metrics_port or None,
+        scrape_interval=args.scrape_interval
+        if args.metrics_port else 0.0)
     events.write({"event": "pipeline", "phase": "train_done",
                   "generation": gen, "rc": rc, "time": time.time()})
     return rc
@@ -614,6 +674,9 @@ def _start_fleet(args, dirs: Dict[str, str], base_port: int,
            "--health-interval", str(args.health_interval),
            "--health-grace", str(args.health_grace),
            "--grace", str(args.grace),
+           # fleet scrape cadence: per-replica QPS/p99/shed/restarts
+           # into telemetry/serve.jsonl.fleet (docs/OBSERVABILITY.md)
+           "--scrape-interval", str(args.scrape_interval),
            "--log-dir", os.path.join(dirs["logs"], "fleet"), "--",
            sys.executable, "-m", "lightgbm_tpu", "serve",
            dirs["publish"],
@@ -624,6 +687,11 @@ def _start_fleet(args, dirs: Dict[str, str], base_port: int,
            "--shed-queue-rows", str(args.shed_queue_rows),
            "--shed-p99-ms", str(args.shed_p99_ms),
            "--grace", str(args.grace)]
+    if args.metrics_port:
+        # fleet supervisor /metrics at base+2; it exports base+3 so
+        # serve replica r binds base+3+r (the daemon adds its rank)
+        idx = cmd.index("--log-dir")
+        cmd[idx:idx] = ["--metrics-port", str(args.metrics_port + 2)]
     log_path = os.path.join(dirs["logs"], "fleet_supervisor.log")
     log_file = open(log_path, "ab")
     try:
@@ -711,6 +779,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     ports = [base_port + r for r in range(args.replicas)]
     events = _EventLog(os.path.join(dirs["telemetry"],
                                     "pipeline.jsonl"))
+    client_metrics = _ClientMetrics()
+    if args.metrics_port:
+        # the supervisor's own jax-free /metrics: supervisor counters
+        # (restart budget, publish totals) + the loadgen client view
+        from .obs.export import ensure_metrics_server
+        ensure_metrics_server(args.metrics_port,
+                              extra_families=client_metrics.families)
     events.write({"event": "pipeline", "phase": "start",
                   "generations": args.generations,
                   "replicas": args.replicas, "ports": ports,
@@ -748,6 +823,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 rows_per_request=args.request_rows,
                 event_log=events)
             loadgen.start()
+            client_metrics.attach(loadgen)
         # the bootstrap model was loaded at startup, not hot-swapped:
         # confirm the fleet serves it before retraining begins
         if not _confirm_swap(ports, first[1]["sha256"],

@@ -232,7 +232,8 @@ def _lambdarank_grads(score, q_idx, q_mask, gain_of_row, weight,
     return g, h
 
 
-register_jit("ranking/lambdarank_grads", _lambdarank_grads)
+_lambdarank_grads = register_jit("ranking/lambdarank_grads",
+                                 _lambdarank_grads)
 
 
 class RankXENDCG(Objective):

@@ -75,7 +75,7 @@ from ..utils.log import log_info, log_warning
 
 __all__ = ["main", "supervise", "supervise_fleet", "worker_env",
            "strip_one_shot_faults", "RestartBudget", "replica_ping",
-           "replica_rpc"]
+           "replica_rpc", "fleet_telemetry_path"]
 
 #: fault kinds that must not re-fire after a supervised restart
 _ONE_SHOT_KINDS = ("rank_kill", "stall_rank", "serve_kill")
@@ -194,6 +194,211 @@ def replica_ping(port: int, timeout: float = 5.0,
     return bool(reply and reply.get("ok"))
 
 
+class _FleetTelemetry:
+    """Append-only JSONL writer for the supervisor's ``{"event":
+    "fleet"}`` scrape records. Only the supervision loop writes (one
+    thread), so no lock; an unwritable path degrades to registry-only
+    scraping, mirroring the recorder's contract."""
+
+    def __init__(self, path: Optional[str]):
+        self._file = None
+        if not path:
+            return
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(path)),
+                        exist_ok=True)
+            self._file = open(path, "a", encoding="utf-8")
+        except OSError as e:
+            log_warning(f"elastic: cannot open fleet telemetry "
+                        f"{path!r} ({e}); fleet events will not be "
+                        "written")
+
+    def write(self, event: Dict) -> None:
+        if self._file is None:
+            return
+        try:
+            self._file.write(json.dumps(event) + "\n")
+            self._file.flush()
+        except OSError:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+
+    def close(self) -> None:
+        if self._file is not None:
+            try:
+                self._file.close()
+            except OSError:
+                pass
+            self._file = None
+
+
+def fleet_telemetry_path(env: Optional[Dict[str, str]] = None) \
+        -> Optional[str]:
+    """Where a supervisor writes its scrape records: the run's
+    telemetry stream (from ``env``, default ``os.environ``) with a
+    ``.fleet`` suffix — the serve replicas own the base path (rank 0)
+    and its ``.rankN`` suffixes, and ``lightgbm_tpu stats <dir>
+    --fleet`` merges all of them."""
+    base = (os.environ if env is None else env).get(
+        "LIGHTGBM_TPU_TELEMETRY")
+    return f"{base}.fleet" if base else None
+
+
+#: replica-row field <- OpenMetrics sample of the replica's metrics
+#: render (serve/daemon.py metrics_families + its registry counters)
+_REPLICA_SAMPLES = (
+    ("qps", "lightgbm_tpu_serve_qps"),
+    ("p50_ms", "lightgbm_tpu_serve_p50_ms"),
+    ("p99_ms", "lightgbm_tpu_serve_p99_ms"),
+    ("requests_total", "lightgbm_tpu_serve_requests_total"),
+    ("rows_total", "lightgbm_tpu_serve_rows_total"),
+    ("shed_total", "lightgbm_tpu_serve_shed_total"),
+    ("swaps_total", "lightgbm_tpu_serve_swaps_total"),
+)
+
+
+def _replica_metrics_row(port: int, timeout: float) -> Dict:
+    """One replica's scrape via the NON-consuming ``{"cmd":
+    "metrics"}`` verb — ``{"cmd": "stats"}`` would reset the daemon's
+    own qps rate window and steal its recompile deltas (the daemon
+    caches its last stats window precisely so metrics reads never
+    consume it). Empty dict on any failure."""
+    from ..obs.export import parse_openmetrics
+    reply = replica_rpc(port, {"cmd": "metrics"}, timeout=timeout)
+    if not reply or not reply.get("ok"):
+        return {}
+    try:
+        samples = parse_openmetrics(reply["metrics"])
+    except (KeyError, TypeError, ValueError):
+        return {}
+    row: Dict = {}
+    for key, name in _REPLICA_SAMPLES:
+        fam = samples.get(name)
+        if fam:
+            row[key] = next(iter(fam.values()))
+    info = samples.get("lightgbm_tpu_serve_model_info")
+    if info:
+        labels = dict(next(iter(info.keys())))
+        if labels.get("model"):
+            row["model"] = labels["model"]
+    return row
+
+
+def _scrape_fleet(fleet: List["_Replica"], health_port: Optional[int],
+                  health_timeout: float) -> Dict:
+    """One scrape round over the replica fleet: liveness + restart
+    generation from the supervisor's own bookkeeping, QPS/p99/shed
+    from each live replica's ``{"cmd": "metrics"}`` protocol verb.
+    Feeds the supervisor's registry (its /metrics endpoint) and
+    returns the ``{"event": "fleet"}`` record."""
+    from ..obs.registry import registry
+    replicas = []
+    restarts_total = 0
+    for rep in fleet:
+        alive = (not rep.done and rep.relaunch_at is None
+                 and rep.proc is not None and rep.proc.poll() is None)
+        row: Dict = {"rank": rep.rank, "alive": alive,
+                     "restarts": rep.generation}
+        restarts_total += rep.generation
+        if alive and health_port is not None:
+            row.update(_replica_metrics_row(health_port + rep.rank,
+                                            health_timeout))
+        replicas.append(row)
+        try:
+            labels = {"rank": rep.rank}
+            registry.gauge("fleet_replica_up", **labels).set(
+                1.0 if alive else 0.0)
+            registry.gauge("fleet_replica_restarts", **labels).set(
+                rep.generation)
+            for key, fam in (("qps", "fleet_replica_qps"),
+                             ("p99_ms", "fleet_replica_p99_ms"),
+                             ("shed_total", "fleet_replica_shed")):
+                if row.get(key) is not None:
+                    registry.gauge(fam, **labels).set(row[key])
+        except Exception:
+            pass                  # telemetry must never kill the loop
+    return {"event": "fleet", "shape": "replicas",
+            "replicas": replicas, "restarts_total": restarts_total,
+            "time": time.time()}
+
+
+def _scrape_world_ranks(nprocs: int, worker_metrics_base: int,
+                        timeout: float = 2.0) -> Optional[Dict]:
+    """One scrape round over a TRAINING world's per-rank /metrics
+    endpoints (rank r binds ``worker_metrics_base + r``): per-rank
+    iteration/recompile counts and the cross-rank iteration skew —
+    the straggler signal chip-level phase aggregation cannot see once
+    a rank's process is wedged. An unreachable endpoint — a wedged
+    rank, or a bind failure — is exactly the condition this scrape
+    exists to surface, so it records an ``alive: false`` row instead
+    of silently shrinking the rank list. None when NO endpoint
+    answered (nothing to distinguish 'all wedged' from 'metrics not
+    up yet' on the first cadence)."""
+    import urllib.request
+
+    from ..obs.export import parse_openmetrics
+    from ..obs.registry import registry
+    ranks = []
+    iterations = []
+    any_alive = False
+    for rank in range(nprocs):
+        url = (f"http://127.0.0.1:{worker_metrics_base + rank}"
+               "/metrics")
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as resp:
+                samples = parse_openmetrics(
+                    resp.read().decode("utf-8"))
+        except (OSError, ValueError):
+            ranks.append({"rank": rank, "alive": False})
+            try:
+                registry.gauge("fleet_rank_up", rank=rank).set(0.0)
+            except Exception:
+                pass
+            continue
+
+        def sample(name: str) -> Optional[float]:
+            fam = samples.get("lightgbm_tpu_" + name)
+            if not fam:
+                return None
+            return next(iter(fam.values()))
+
+        any_alive = True
+        row: Dict = {"rank": rank, "alive": True}
+        for key, metric in (("iterations", "iterations_total"),
+                            ("recompiles", "jit_recompiles_total"),
+                            ("hbm_bytes_in_use", "hbm_bytes_in_use")):
+            value = sample(metric)
+            if value is not None:
+                row[key] = value
+        ranks.append(row)
+        try:
+            registry.gauge("fleet_rank_up", rank=rank).set(1.0)
+        except Exception:
+            pass
+        if row.get("iterations") is not None:
+            iterations.append(row["iterations"])
+            try:
+                registry.gauge("fleet_rank_iterations",
+                               rank=rank).set(row["iterations"])
+            except Exception:
+                pass
+    if not any_alive:
+        return None
+    skew = int(max(iterations) - min(iterations)) if iterations \
+        else None
+    if skew is not None:
+        try:
+            registry.gauge("fleet_iteration_skew").set(skew)
+        except Exception:
+            pass
+    return {"event": "fleet", "shape": "world", "nprocs": nprocs,
+            "ranks": ranks, "iteration_skew": skew,
+            "time": time.time()}
+
+
 def strip_one_shot_faults(spec: str) -> str:
     """Drop ``rank_kill``/``stall_rank``/``serve_kill`` tokens from a
     ``LIGHTGBM_TPU_FAULT_INJECT`` value for a relaunch."""
@@ -246,13 +451,18 @@ def _launch_generation(cmd: Sequence[str], nprocs: int, port: int,
 
 
 def _wait_generation(procs: List[subprocess.Popen],
-                     grace: float) -> int:
+                     grace: float,
+                     on_poll=None) -> int:
     """Block until the generation resolves: 0 when every rank exited
     cleanly, else the first nonzero exit code (the rest of the world is
     killed after ``grace`` seconds — survivors are either hung in a
     collective or about to watchdog-abort; their state is already
-    checkpointed)."""
+    checkpointed). ``on_poll`` (optional zero-arg callable) runs once
+    per poll round — the metrics scrape cadence rides the existing
+    supervision loop instead of a thread."""
     while True:
+        if on_poll is not None:
+            on_poll()
         first_bad: Optional[subprocess.Popen] = None
         alive = 0
         for p in procs:
@@ -292,7 +502,9 @@ def supervise(nprocs: int, cmd: Sequence[str], max_restarts: int = 3,
               grace: float = 5.0,
               env: Optional[Dict[str, str]] = None,
               max_restarts_per_window: int = 0,
-              restart_window_sec: float = 300.0) -> int:
+              restart_window_sec: float = 300.0,
+              metrics_port: Optional[int] = None,
+              scrape_interval: float = 0.0) -> int:
     """Run ``cmd`` as an ``nprocs``-rank world under supervision;
     returns the final exit code (0 = a generation completed cleanly).
 
@@ -313,6 +525,35 @@ def supervise(nprocs: int, cmd: Sequence[str], max_restarts: int = 3,
     os.makedirs(log_dir, exist_ok=True)
     budget = RestartBudget(max_restarts, max_restarts_per_window,
                            restart_window_sec)
+    # fleet metrics plane (docs/OBSERVABILITY.md): the supervisor
+    # serves its own jax-free /metrics at the base port, workers bind
+    # base+1+rank (engine.py reads LIGHTGBM_TPU_METRICS_PORT and adds
+    # its rank), and the supervision loop scrapes the rank endpoints
+    # into {"event": "fleet"} records carrying the iteration skew
+    if metrics_port:
+        from ..obs.export import ensure_metrics_server
+        ensure_metrics_server(metrics_port)
+        base_env["LIGHTGBM_TPU_METRICS_PORT"] = str(metrics_port + 1)
+    # world-shape scraping reads the rank /metrics endpoints, so it
+    # needs metrics_port; without it the .fleet file must not even be
+    # created (an empty stray artifact per run otherwise)
+    telem = _FleetTelemetry(
+        fleet_telemetry_path(base_env)
+        if scrape_interval > 0 and metrics_port else None)
+    next_scrape = time.monotonic() + max(0.0, scrape_interval)
+
+    def _poll_scrape() -> None:
+        nonlocal next_scrape
+        if scrape_interval <= 0 or not metrics_port:
+            return
+        now = time.monotonic()
+        if now < next_scrape:
+            return
+        next_scrape = now + scrape_interval
+        event = _scrape_world_ranks(nprocs, metrics_port + 1)
+        if event is not None:
+            telem.write(event)
+
     generation = 0
     consecutive = 0
     while True:
@@ -322,21 +563,25 @@ def supervise(nprocs: int, cmd: Sequence[str], max_restarts: int = 3,
         procs = _launch_generation(cmd, nprocs, gen_port, generation,
                                    log_dir, base_env)
         try:
-            rc = _wait_generation(procs, grace)
+            rc = _wait_generation(procs, grace,
+                                  on_poll=_poll_scrape)
         except BaseException:   # ctrl-C etc.: never leak a world
             for p in procs:
                 if p.poll() is None:
                     _kill_group(p)
+            telem.close()
             raise
         if rc == 0:
             log_info(f"elastic: generation {generation} completed "
                      "cleanly")
+            telem.close()
             return 0
         refusal = budget.admit()
         if refusal is not None:
             log_warning(
                 f"elastic: generation {generation} failed (exit {rc}) "
                 f"and {refusal} — giving up")
+            telem.close()
             return rc
         generation += 1
         consecutive += 1
@@ -402,7 +647,9 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
                     health_interval: float = 2.0,
                     health_fails: int = 3,
                     health_grace: float = 60.0,
-                    health_timeout: float = 5.0) -> int:
+                    health_timeout: float = 5.0,
+                    metrics_port: Optional[int] = None,
+                    scrape_interval: float = 0.0) -> int:
     """Supervise ``nprocs`` INDEPENDENT replicas (the serving shape):
     a dead or health-check-failing replica is relaunched alone, on a
     per-replica jittered backoff, while the rest keep serving.
@@ -426,6 +673,22 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
     os.makedirs(log_dir, exist_ok=True)
     budget = RestartBudget(max_restarts, max_restarts_per_window,
                            restart_window_sec)
+    # fleet metrics plane (docs/OBSERVABILITY.md): the supervisor's
+    # own jax-free /metrics at the base port, replica endpoints at
+    # base+1+rank via the exported env var; the supervision loop
+    # scrapes each live replica's NON-consuming {"cmd": "metrics"}
+    # verb on the scrape cadence into {"event": "fleet"} records
+    # (per-replica QPS / p99 / shed / restarts — ROADMAP 3(b)'s
+    # autoscaling signal; {"cmd": "stats"} would consume the daemon's
+    # own rate window, see _replica_metrics_row)
+    if metrics_port:
+        from ..obs.export import ensure_metrics_server
+        ensure_metrics_server(metrics_port)
+        base_env["LIGHTGBM_TPU_METRICS_PORT"] = str(metrics_port + 1)
+    telem = _FleetTelemetry(
+        fleet_telemetry_path(base_env) if scrape_interval > 0
+        else None)
+    next_scrape = time.monotonic() + max(0.0, scrape_interval)
     fleet = [_Replica(rank) for rank in range(nprocs)]
     last_rc = 1
     next_ping = time.monotonic() + max(0.0, health_grace)
@@ -437,6 +700,10 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
             ping_round = health_port is not None and now >= next_ping
             if ping_round:
                 next_ping = now + max(0.1, health_interval)
+            if scrape_interval > 0 and now >= next_scrape:
+                next_scrape = now + scrape_interval
+                telem.write(_scrape_fleet(fleet, health_port,
+                                          health_timeout))
             for rep in fleet:
                 if rep.done:
                     continue
@@ -508,6 +775,11 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
                     return last_rc
             if all(rep.done for rep in fleet):
                 log_info("elastic: every replica exited cleanly")
+                if scrape_interval > 0:
+                    # final scrape: the restart totals survive into
+                    # the stream even when the cadence never fired
+                    telem.write(_scrape_fleet(fleet, None,
+                                              health_timeout))
                 return 0
             time.sleep(_POLL_SECONDS)
     except BaseException:          # ctrl-C etc.: never leak replicas
@@ -515,6 +787,8 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
             if rep.proc is not None and rep.proc.poll() is None:
                 _kill_group(rep.proc)
         raise
+    finally:
+        telem.close()
 
 
 _HELP_EPILOG = """\
@@ -568,6 +842,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="startup window in seconds during which a "
                         "(re)launched replica is not pinged (model "
                         "load + compile)")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="fleet metrics plane (docs/OBSERVABILITY.md): "
+                        "the supervisor serves its own jax-free "
+                        "OpenMetrics /metrics at this port and exports "
+                        "LIGHTGBM_TPU_METRICS_PORT=<port+1> so worker "
+                        "rank r binds port+1+r (0 = disabled)")
+    p.add_argument("--scrape-interval", type=float, default=0.0,
+                   help="seconds between fleet scrapes written as "
+                        "{\"event\": \"fleet\"} records to "
+                        "$LIGHTGBM_TPU_TELEMETRY.fleet: per-replica "
+                        "QPS/p99/shed/restarts in fleet mode (via "
+                        "the replicas' {\"cmd\": \"metrics\"} verb), "
+                        "per-rank iteration skew in world mode — "
+                        "world mode reads the worker /metrics "
+                        "endpoints, so it also needs --metrics-port "
+                        "(0 = disabled)")
     p.add_argument("--port", type=int, default=0,
                    help="fixed coordinator port (default: a fresh free "
                         "port per generation)")
@@ -614,13 +904,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                 health_port=args.health_port,
                 health_interval=args.health_interval,
                 health_fails=args.health_fails,
-                health_grace=args.health_grace)
+                health_grace=args.health_grace,
+                metrics_port=args.metrics_port or None,
+                scrape_interval=args.scrape_interval)
         return supervise(args.nprocs, cmd,
                          max_restarts=args.max_restarts,
                          port=args.port or None, log_dir=args.log_dir,
                          grace=args.grace, env=env,
                          max_restarts_per_window=args.max_restarts_per_window,
-                         restart_window_sec=args.restart_window)
+                         restart_window_sec=args.restart_window,
+                         metrics_port=args.metrics_port or None,
+                         scrape_interval=args.scrape_interval)
     except KeyboardInterrupt:
         print("launch: interrupted", file=sys.stderr)
         return 130

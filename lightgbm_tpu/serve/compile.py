@@ -89,7 +89,8 @@ def _predict_scores_padded(stacked: StackedTrees, X: jnp.ndarray,
     return scores.T                                      # [n, K]
 
 
-register_jit("serve/predict", _predict_scores_padded)
+_predict_scores_padded = register_jit("serve/predict",
+                                      _predict_scores_padded)
 
 
 @partial(jax.jit, donate_argnums=(0,))

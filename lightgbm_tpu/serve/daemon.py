@@ -11,6 +11,9 @@ A stdlib-socket JSON-lines server over one compiled forest
       {"cmd": "ping"}                   -> {"ok": true, "model": ...,
                                             "pid": ...}
       {"cmd": "stats"}                  -> queue/latency/model snapshot
+      {"cmd": "metrics"}                -> OpenMetrics text (the
+                                           /metrics render over the
+                                           protocol; obs/export.py)
       {"cmd": "shutdown"}               -> stops the daemon (testing /
                                            drains first)
 
@@ -102,6 +105,11 @@ class ServeState:
         self._requests_accepted = 0
         self._active_handlers = 0
         self._last_stats: Dict[str, Any] = {}
+        # newest computed rates (qps / rows_per_sec), cached so the
+        # /metrics scrape can export them WITHOUT consuming the
+        # stats() rate window (scrapes must never shrink the serve
+        # event cadence's window)
+        self._last_rates: Dict[str, Any] = {}
         self._telemetry_file = None
         self.shutdown_event = threading.Event()
         self._t0 = time.monotonic()
@@ -216,7 +224,55 @@ class ServeState:
         out["hbm"] = hbm
         gauge = self.registry.gauge("serve_queue_depth_rows")
         gauge.set(snap["queue_depth_rows"])
+        with self._lock:
+            self._last_rates = {"qps": out["qps"],
+                                "rows_per_sec": out["rows_per_sec"]}
         return out
+
+    # -- OpenMetrics export (obs/export.py) ----------------------------
+    def metrics_families(self) -> Dict[str, Any]:
+        """Serve-side families merged into the /metrics render and the
+        ``{"cmd": "metrics"}`` protocol verb: the batcher's cumulative
+        counters and latency percentiles (non-destructive reads), the
+        newest rate window computed by the stats cadence, HBM gauges,
+        and the serving model identity as an info-style labeled gauge.
+        Runs on scrape/handler threads: shared fields are read under
+        ``self._lock``, device queries outside it (TPL006/TPL008)."""
+        from ..obs import device_memory_stats
+        from ..obs.export import counter_family, gauge_family
+        snap = self.batcher.stats()
+        hbm = device_memory_stats()
+        with self._lock:
+            model_id = self._model_id
+            rates = dict(self._last_rates)
+        fams: Dict[str, Any] = {
+            "serve_requests": counter_family(snap["requests_total"]),
+            "serve_rows": counter_family(snap["rows_total"]),
+            "serve_batches": counter_family(snap["batches_total"]),
+            "serve_rejected": counter_family(snap["rejected_total"]),
+            "serve_shed": counter_family(snap["shed_total"]),
+            "serve_shed_rows": counter_family(snap["shed_rows"]),
+            "serve_queue_depth_rows":
+                gauge_family(snap["queue_depth_rows"]),
+            "serve_p50_ms": gauge_family(snap["p50_ms"]),
+            "serve_p99_ms": gauge_family(snap["p99_ms"]),
+            "serve_qps": gauge_family(rates.get("qps")),
+            "serve_rows_per_sec":
+                gauge_family(rates.get("rows_per_sec")),
+            "serve_model_info": gauge_family(1, model=str(model_id)),
+        }
+        for key in ("bytes_in_use", "peak_bytes_in_use"):
+            if hbm.get(key) is not None:
+                fams[f"hbm_{key}"] = gauge_family(hbm[key])
+        return fams
+
+    def render_metrics(self) -> str:
+        """OpenMetrics text for the protocol verb: the process
+        registry (swaps/sheds/xla compiles) plus the serve families.
+        Snapshot under the registry lock, render outside (TPL006)."""
+        from ..obs.export import render_openmetrics
+        return render_openmetrics(self.registry.snapshot(),
+                                  extra=self.metrics_families())
 
     def emit_serve_event(self) -> None:
         """One ``{"event": "serve"}`` JSONL line (degrades like the
@@ -230,6 +286,13 @@ class ServeState:
             from ..resilience.faults import FAULT_EVENTS, drain_events
             if FAULT_EVENTS:
                 faults = drain_events(FAULT_EVENTS)
+        except Exception:
+            pass
+        try:
+            # bucket compiles carry their cost attribution into the
+            # stream (obs/cost.py); drained like fault events
+            from ..obs.cost import drain_compile_events
+            faults = faults + drain_compile_events()
         except Exception:
             pass
         payload = {"event": "serve", **self.stats()}
@@ -279,6 +342,17 @@ def handle_request(obj: Any, state: ServeState) -> Dict[str, Any]:
                     "pid": os.getpid()}
         if cmd == "stats":
             return {"ok": True, **state.stats()}
+        if cmd == "metrics":
+            # OpenMetrics text over the JSON protocol: what the HTTP
+            # /metrics endpoint serves, for consumers already holding
+            # a protocol connection (the fleet supervisor's scraper)
+            from ..obs.export import CONTENT_TYPE
+            try:
+                body = state.render_metrics()
+            except Exception as e:
+                return {"error": f"metrics render failed: {e}"}
+            return {"ok": True, "content_type": CONTENT_TYPE,
+                    "metrics": body}
         if cmd == "shutdown":
             state.request_shutdown()
             return {"ok": True, "shutting_down": True}
@@ -644,6 +718,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "SIGTERM / the shutdown command the daemon "
                         "drains already-accepted requests for up to "
                         "this long before closing")
+    p.add_argument("--metrics-port", type=int,
+                   default=Config.metrics_port,
+                   help="base port of the OpenMetrics /metrics HTTP "
+                        "endpoint (obs/export.py); a launch-supervised "
+                        "replica adds its rank. 0 disables (default: "
+                        "$LIGHTGBM_TPU_METRICS_PORT or off)")
     p.add_argument("--warmup-rows", type=int, default=None,
                    help="pre-compile buckets up to this many rows at "
                         "startup (default: all buckets; 0 disables)")
@@ -737,6 +817,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 1
     server.state = state                     # type: ignore[attr-defined]
     bound_port = server.server_address[1]
+    metrics_port = args.metrics_port
+    if not metrics_port:
+        try:
+            metrics_port = int(os.environ.get(
+                "LIGHTGBM_TPU_METRICS_PORT", "0") or 0)
+        except ValueError:
+            metrics_port = 0
+    metrics_server = None
+    if metrics_port:
+        from ..obs.export import ensure_metrics_server
+        metrics_server = ensure_metrics_server(
+            metrics_port + rank,
+            extra_families=state.metrics_families)
     if watch_dir:
         _Watcher(state, watch_dir, args.watch_interval, compile_kwargs,
                  watch_key, args.warmup_rows).start()
@@ -745,6 +838,8 @@ def main(argv: Optional[List[str]] = None) -> int:
              "port": bound_port, "pid": os.getpid(), "rank": rank,
              "model": forest.model_id, "model_source": model_path,
              "watch_dir": watch_dir,
+             "metrics_port": None if metrics_server is None
+             else metrics_server.port,
              "buckets": forest.buckets()}
     print(json.dumps(ready), flush=True)
     log_info(f"serve: listening on {args.host}:{bound_port} "

@@ -1,0 +1,38 @@
+# tpulint fixture: TPL008 negative — the scrape endpoint of
+# tpl008_export_pos.py with the shared bookkeeping correctly guarded:
+# every handler-thread mutation and every main-path read goes through
+# the one module lock, so the rule's lock-acquisition proof discharges
+# all of them.
+import http.server
+import socketserver
+import threading
+
+_scrape_lock = threading.Lock()
+_scrapes = {}          # port -> scrape count, shared with readers
+
+
+class ScrapeHandler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        with _scrape_lock:
+            port = self.server.server_address[1]
+            _scrapes[port] = _scrapes.get(port, 0) + 1
+        self.send_response(200)
+        self.end_headers()
+
+
+class ProtocolHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        with _scrape_lock:
+            _scrapes["protocol"] = _scrapes.get("protocol", 0) + 1
+
+
+def scrape_count(port):
+    with _scrape_lock:
+        return _scrapes.get(port, 0)
+
+
+def start(port):
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                             ScrapeHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server

@@ -1,0 +1,40 @@
+# tpulint fixture: TPL008 positive — a /metrics scrape endpoint whose
+# request-handler threads mutate shared scrape bookkeeping with no
+# lock. Handler methods of http.server/socketserver request-handler
+# subclasses run on the serving stack's per-connection daemon threads
+# (ThreadingHTTPServer), which no Thread(target=...) spawn reveals —
+# the analyzer seeds them thread-side from the class bases. This is
+# the strip-the-export-lock acceptance shape: obs/tpl008_export_neg.py
+# is the same endpoint WITH the lock, and removing it must re-surface
+# these findings.
+import http.server
+import socketserver
+import threading
+
+_scrapes = {}          # port -> scrape count, shared with readers
+
+
+class ScrapeHandler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self):
+        # EXPECT: TPL008
+        _scrapes[self.server.server_address[1]] = \
+            _scrapes.get(self.server.server_address[1], 0) + 1
+        self.send_response(200)
+        self.end_headers()
+
+
+class ProtocolHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        # EXPECT: TPL008
+        _scrapes["protocol"] = _scrapes.get("protocol", 0) + 1
+
+
+def scrape_count(port):
+    return _scrapes.get(port, 0)
+
+
+def start(port):
+    server = http.server.ThreadingHTTPServer(("127.0.0.1", port),
+                                             ScrapeHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server

@@ -45,6 +45,12 @@ def _isolate_process_fault_log():
     from lightgbm_tpu.resilience.faults import FAULT_EVENTS, drain_events
     if FAULT_EVENTS:
         drain_events(FAULT_EVENTS)
+    # same contract for the process-level XLA compile-event queue
+    # (obs/cost.py): a test that compiles jitted entry points without
+    # draining would otherwise leak {"event": "compile"} lines into an
+    # unrelated later test's JSONL stream
+    from lightgbm_tpu.obs.cost import drain_compile_events
+    drain_compile_events()
 
 
 @pytest.fixture(scope="session")

@@ -182,6 +182,7 @@ _FIXTURES = [
     "data/tpl007_pos.py", "data/tpl007_neg.py",
     "obs/tpl008_pos.py", "obs/tpl008_neg.py",
     "obs/tpl008_pragma.py",
+    "obs/tpl008_export_pos.py", "obs/tpl008_export_neg.py",
     "serve/tpl008_pos.py", "serve/tpl008_neg.py",
     "pipeline/tpl006_pos.py", "pipeline/tpl006_neg.py",
     "pipeline/tpl008_pos.py", "pipeline/tpl008_neg.py",
@@ -582,6 +583,42 @@ def test_stripping_the_loadgen_lock_fails(tmp_path):
             "shared:self._counts#1") in fids, fids
     assert ("TPL008:pipeline.py:LoadGenerator._note:"
             "shared:self._latencies#1") in fids, fids
+
+
+def test_stripping_the_export_lock_fails(tmp_path):
+    """Fleet-metrics acceptance mutation (ISSUE 15): strip the lock
+    around the /metrics endpoint's scrape bookkeeping
+    (obs/export.py _Handler.do_GET) -> TPL008 names the module-global
+    counter the handler threads mutate and scrape_count() reads
+    concurrently. The seeding is the request-handler-thread rule:
+    ThreadingHTTPServer runs do_GET on per-connection threads no
+    Thread(target=...) spawn reveals."""
+    anchor = ("                with _scrape_lock:\n"
+              "                    count = _scrape_counts.get("
+              "exporter.port, 0) + 1\n")
+    res = _lint_mutated(
+        "obs/export.py",
+        lambda src: src.replace(
+            anchor,
+            "                if True:\n"
+            "                    count = _scrape_counts.get("
+            "exporter.port, 0) + 1\n"),
+        ["TPL008"], tmp_path)
+    fids = [f.fid for f in res.findings]
+    assert ("TPL008:obs/export.py:"
+            "MetricsHTTPServer.__init__._Handler.do_GET:"
+            "shared:_scrape_counts#1") in fids, fids
+
+
+def test_metrics_plane_is_thread_and_lock_clean():
+    """The shipped fleet-metrics modules (obs/export.py, obs/cost.py)
+    lint clean for the lock-across-dispatch and thread-shared-state
+    rules — the new scrape/capture paths carry their locks."""
+    res = run_lint(root=PKG, rules=["TPL006", "TPL008"],
+                   baseline_path=BASELINE,
+                   files=["obs/export.py", "obs/cost.py",
+                          "obs/recorder.py", "obs/jit_tracker.py"])
+    assert not res.findings, [f.fid for f in res.findings]
 
 
 def test_pipeline_and_publisher_are_thread_clean():
