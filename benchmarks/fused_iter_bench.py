@@ -23,6 +23,7 @@ FLIP gates:
 
 Run:  python benchmarks/fused_iter_bench.py
 """
+import json
 import os
 import sys
 
@@ -57,8 +58,11 @@ PARAMS = {"objective": "binary",
 # fetch, timed under boosting/fused_scan). Per-iteration dispatches
 # return async, so their in-call time IS the dispatch + Python driver
 # overhead the scan deletes; the device wait then accrues at the final
-# block_until_ready and lands in (wall - driver).
-_BLOCKING_PHASES = ("boosting/fused_scan",)
+# block_until_ready and lands in (wall - driver). The phase list is
+# THE one the tracing plane's per-iteration host-gap derivation
+# subtracts (obs/trace.py record_iteration_spans) — same source of
+# truth, so the bench arms and the span attrs can never disagree.
+from lightgbm_tpu.obs.trace import BLOCKING_PHASES as _BLOCKING_PHASES
 
 
 def _phase_total(snap, labels):
@@ -116,6 +120,18 @@ def run(tag, fused, iters=10, hist_method=None, scan=0):
               f"{(wall / iters - driver) * 1e3:.2f} ms/iter, host "
               f"driver {driver * 1e3:.2f} ms/iter (inter-iteration "
               f"gap)", flush=True)
+        # one machine-readable line per flip-gate arm: the span-
+        # derived host-gap decomposition next to the wall number, so
+        # the revive battery's greps AND the trace plane's host_gap_s
+        # attrs reconcile against the same record
+        print(json.dumps({
+            "event": "bench_arm", "arm": tag, "iters": iters,
+            "ms_per_iter": round(dt * 1e3, 3),
+            "iters_per_sec": round(1 / dt, 4),
+            "device_ms_per_iter": round((wall / iters - driver) * 1e3,
+                                        3),
+            "host_gap_ms_per_iter": round(driver * 1e3, 3),
+            "blocking_phases": list(_BLOCKING_PHASES)}), flush=True)
         return dt, driver
     finally:
         if not fused:

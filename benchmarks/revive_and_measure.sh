@@ -18,6 +18,10 @@
 #      (f32 vs int16 vs int8 histogram allreduce at the Allstate-wide
 #       shape on 8 devices; its verdict gates hist_comm auto -> int8,
 #       docs/COLLECTIVES.md)
+#   6. benchmarks/serve_bench.py     -> benchmarks/SERVE_r06.json
+#      (ROADMAP 3d: on-chip serving rows/s + p99 through the real
+#       CompiledForest + MicroBatcher stack, with the span-derived
+#       queue/batch/dispatch stage decomposition in the same line)
 # Each step is individually time-bounded so a mid-battery tunnel death
 # still leaves earlier results on disk.
 cd "$(dirname "$0")/.." || exit 1
@@ -40,7 +44,7 @@ while :; do
     sleep "$PROBE_INTERVAL"
 done
 
-log "step 1/5: decompose_iter"
+log "step 1/6: decompose_iter"
 timeout 2400 python benchmarks/decompose_iter.py \
     > benchmarks/DECOMP_r06.txt 2>&1
 log "decompose rc=$? (results in benchmarks/DECOMP_r06.txt)"
@@ -54,24 +58,29 @@ bench_status() {  # $1 = json file
     else echo NO-OUTPUT; fi
 }
 
-log "step 2/5: full Higgs bench"
+log "step 2/6: full Higgs bench"
 BENCH_DEADLINE=1800 timeout 2000 python bench.py \
     > benchmarks/BENCH_LOCAL_r06.json 2>benchmarks/BENCH_LOCAL_r06.err
 log "higgs bench $(bench_status benchmarks/BENCH_LOCAL_r06.json): $(cat benchmarks/BENCH_LOCAL_r06.json)"
 
-log "step 3/5: allstate preset"
+log "step 3/6: allstate preset"
 BENCH_PRESET=allstate BENCH_DEADLINE=3000 timeout 3200 python bench.py \
     > benchmarks/BENCH_ALLSTATE_r06.json 2>benchmarks/BENCH_ALLSTATE_r06.err
 log "allstate bench $(bench_status benchmarks/BENCH_ALLSTATE_r06.json): $(cat benchmarks/BENCH_ALLSTATE_r06.json)"
 
-log "step 4/5: fused_iter_bench (pallas + scan flip gates)"
+log "step 4/6: fused_iter_bench (pallas + scan flip gates)"
 timeout 3000 python benchmarks/fused_iter_bench.py \
     > benchmarks/FUSED_r06.txt 2>&1
 log "fused_iter rc=$? pallas verdict: $(grep -a 'pallas vs mxu' benchmarks/FUSED_r06.txt || echo none)"
 log "fused_iter scan verdict: $(grep -a 'scan vs fused' benchmarks/FUSED_r06.txt || echo none)"
 
-log "step 5/5: quant_bench --comms (hist_comm flip gate)"
+log "step 5/6: quant_bench --comms (hist_comm flip gate)"
 timeout 1200 python benchmarks/quant_bench.py --comms \
     > benchmarks/COMMS_r06.txt 2>&1
 log "comms rc=$? verdict: $(grep -a 'vs f32 allreduce' benchmarks/COMMS_r06.txt || echo none)"
+
+log "step 6/6: serve_bench (on-chip rows/s + p99, ROADMAP 3d)"
+timeout 1200 python benchmarks/serve_bench.py \
+    > benchmarks/SERVE_r06.json 2>benchmarks/SERVE_r06.err
+log "serve bench $(bench_status benchmarks/SERVE_r06.json): $(cat benchmarks/SERVE_r06.json)"
 log "battery done"

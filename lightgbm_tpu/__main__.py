@@ -26,6 +26,14 @@ if __name__ == "__main__":
         from .pipeline import main as pipeline_main
         raise SystemExit(pipeline_main(sys.argv[2:]))
 
+    # `trace` merges the fleet's telemetry streams into a clock-
+    # corrected Chrome trace-event export + critical-path table
+    # (obs/trace.py, docs/OBSERVABILITY.md "Tracing"). Pure JSONL
+    # post-processing — jax-free like `lint` and `launch`.
+    if len(sys.argv) > 1 and sys.argv[1] == "trace":
+        from .obs.trace import main as trace_main
+        raise SystemExit(trace_main(sys.argv[2:]))
+
     # `serve` is the inference daemon (serve/daemon.py). Its argument
     # parse, --help and bad-model-path errors are jax-free (the serve
     # package __init__ is PEP-562 lazy); jax loads only once a model

@@ -14,6 +14,7 @@ Usage:
     python -m lightgbm_tpu lint [--help]       # tpulint static analyzer
     python -m lightgbm_tpu launch 4 -- <cmd>   # elastic restart supervisor
     python -m lightgbm_tpu serve model.txt     # inference daemon
+    python -m lightgbm_tpu trace telemetry/    # merge spans -> Perfetto
 
 Config-file syntax matches the reference (application.cpp:50-86 +
 config.cpp KV2Map): one ``key = value`` per line, ``#`` comments;
@@ -376,6 +377,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         # for programmatic main() callers
         from .serve.daemon import main as serve_main
         return serve_main(argv[1:])
+    if argv[0] == "trace":
+        # likewise dispatched jax-free in __main__.py; kept here for
+        # programmatic main() callers
+        from .obs.trace import main as trace_main
+        return trace_main(argv[1:])
     try:
         params = parse_args(argv)
         cfg = Config.from_params(params)

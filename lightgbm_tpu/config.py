@@ -536,6 +536,15 @@ class Config:
     # children's stats into {"event": "fleet"} telemetry records
     # (docs/OBSERVABILITY.md "Fleet events"). 0 disables scraping
     metrics_scrape_interval_sec: float = 5.0
+    # distributed-tracing sample rate (obs/trace.py, docs/
+    # OBSERVABILITY.md "Tracing"): the pipeline's load generator
+    # originates a trace on every Nth request — the traced request
+    # carries a {"trace": ...} protocol field and the serve replica
+    # answers it with queue-wait / batch-window / dispatch / reply
+    # spans, merged by `python -m lightgbm_tpu trace <dir>`.
+    # 0 disables request-trace sampling (train/publish/swap spans are
+    # always on — they cost one clock pair per iteration/publication)
+    trace_sample_every: int = 16
 
     # ---- publish (resilience/publisher.py; docs/PIPELINE.md) ----
     # retry budget for one atomic model publication into the serve
@@ -729,6 +738,7 @@ class Config:
         "publish_backoff_sec": (0.0, None),
         "metrics_port": (0, 65535),
         "metrics_scrape_interval_sec": (0.0, None),
+        "trace_sample_every": (0, None),
         "metric_freq": (1, None),
         "multi_error_top_k": (1, None),
     }

@@ -19,7 +19,7 @@ from . import callback as callback_mod
 from .basic import Booster, Dataset, LightGBMError
 from .config import Config, resolve_params
 from .utils.log import log_info, log_warning, scoped_verbosity
-from .utils.timer import Timer, timed
+from .utils.timer import EnvCapture, Timer, timed
 
 
 def _setup_metrics_endpoint(cfg: Config) -> None:
@@ -284,10 +284,16 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
     end_iteration = max(begin_iteration,
                         init_iteration + num_boost_round)
     evaluation_result_list: List[Tuple] = []
+    # env-driven device captures (LIGHTGBM_TPU_TRACE_TO whole-run /
+    # LIGHTGBM_TPU_XPROF=dir:iters=A-B window); None — and zero
+    # per-iteration cost — when neither knob is set
+    env_capture = EnvCapture.from_env()
     try:
         for i in range(begin_iteration, end_iteration):
             fault_plan.maybe_kill(i)
             fault_plan.maybe_distributed_fault(i)
+            if env_capture is not None:
+                env_capture.before_iteration(i)
             if booster._engine is not None:
                 # fused-scan lookahead (docs/FUSED.md): the engine
                 # loop is the only place that knows the callback set
@@ -332,6 +338,8 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
                 evaluation_result_list = es.best_score
                 # roll the model back to best_iteration for storage parity
                 break
+            if env_capture is not None:
+                env_capture.after_iteration(i)
             if finished:
                 log_info("Stopped training because there are no more "
                          "leaves that meet the split requirements")
@@ -349,6 +357,9 @@ def _train_impl(params: Dict[str, Any], cfg: Config, train_set: Dataset,
             # stop / an exception) must not dispatch windows from
             # plain update() calls
             booster._engine._scan_horizon = 1
+        if env_capture is not None:
+            # finalize capture files even when the loop raised
+            env_capture.close()
         _finish_callbacks(callbacks)
 
     if booster.best_iteration <= 0:

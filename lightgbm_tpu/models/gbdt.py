@@ -28,6 +28,7 @@ import numpy as np
 
 from ..config import Config
 from ..obs import register_jit
+from ..obs.trace import FUSED_SCAN_PHASE
 from ..objectives import Objective
 from ..resilience.faults import FaultPlan, is_resource_exhausted
 from ..ops.gather import gather_small
@@ -1706,7 +1707,10 @@ class GBDTBooster:
             if self._fmask_cached is None:
                 self._fmask_cached = self._feature_mask()
             fmask = self._fmask_cached
-        with timed("boosting/fused_scan"):
+        # label defined in obs/trace.py (FUSED_SCAN_PHASE): the
+        # jax-free tracing layer, the bench and the per-iteration
+        # host-gap derivation all key on this exact phase name
+        with timed(FUSED_SCAN_PHASE):
             def dispatch():
                 # re-reads _get_scan_fn so an OOM downgrade's rebuilt
                 # program is picked up on the retry — and re-derives

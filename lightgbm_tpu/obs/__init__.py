@@ -27,6 +27,11 @@ is a strict no-op when disabled:
   registered entry point's first compile per signature records
   flops / bytes / compile wall / cost-model-optimal ms as
   ``{"event": "compile"}`` telemetry (docs/ROOFLINE.md made live).
+- :mod:`~lightgbm_tpu.obs.trace` — the distributed tracing plane:
+  jax-free spans (``{"event": "span"}``) across the whole
+  train -> publish -> serve lifecycle, clock-skew-corrected and
+  merged into Perfetto-loadable Chrome trace JSON plus named
+  critical paths by ``python -m lightgbm_tpu trace <dir>``.
 
 See docs/OBSERVABILITY.md for the event schema and workflow.
 """
@@ -43,6 +48,9 @@ from .recorder import (ITERATION_EVENT_KEYS, TelemetryRecorder,
                        render_stats_table, summarize_directory,
                        summarize_events)
 from .registry import Counter, Gauge, Histogram, MetricsRegistry, registry
+from .trace import (SPAN_EVENT_KEYS, current_context, drain_span_events,
+                    new_span_id, new_trace_id, record_span,
+                    set_current_trace, span, span_events_snapshot)
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "registry",
@@ -56,4 +64,7 @@ __all__ = [
     "ensure_metrics_server",
     "CostTracked", "drain_compile_events", "compile_events_snapshot",
     "device_peaks", "roofline_optimal_ms",
+    "SPAN_EVENT_KEYS", "record_span", "span", "drain_span_events",
+    "span_events_snapshot", "new_trace_id", "new_span_id",
+    "current_context", "set_current_trace",
 ]

@@ -67,6 +67,7 @@ class TelemetryRecorder:
         self._phase_base: Dict[str, Dict[str, float]] = {}
         self._prev_timer_enabled: Optional[bool] = None
         self._t0 = 0.0
+        self._last_iter_mono = 0.0
         self.events_written = 0
 
     # -- lifecycle -----------------------------------------------------
@@ -99,6 +100,7 @@ class TelemetryRecorder:
         self._phase_base = Timer.snapshot()
         self._watcher = RecompileWatcher()
         self._t0 = time.perf_counter()
+        self._last_iter_mono = self._t0
         self._started = True
         try:
             import jax
@@ -151,6 +153,7 @@ class TelemetryRecorder:
         try:
             self._drain_fault_events()
             self._drain_compile_events()
+            self._drain_span_events()
         finally:
             try:
                 if self._file is not None:
@@ -278,6 +281,18 @@ class TelemetryRecorder:
         for ev in drain_compile_events():
             self._write_line(ev)
 
+    def _drain_span_events(self) -> None:
+        """Move pending trace spans (obs/trace.py: the distributed
+        tracing plane's per-iteration, publish and swap spans) into
+        the JSONL stream — the same locked snapshot-and-clear drain
+        as fault and compile events."""
+        try:
+            from .trace import drain_span_events
+        except Exception:
+            return
+        for ev in drain_span_events():
+            self._write_line(ev)
+
     def _drain_fault_events(self) -> None:
         """Move fault events (non-finite guard trips, OOM downgrades;
         models/gbdt.py ``fault_log``) into the JSONL stream, plus the
@@ -328,10 +343,11 @@ class TelemetryRecorder:
         recompile_delta = self._watcher.delta()
         hbm = device_memory_stats()
         tree = self._tree_stats()
+        now_mono = time.perf_counter()
         event = {
             "event": "iteration",
             "iteration": int(iteration),
-            "wall_time": time.perf_counter() - self._t0,
+            "wall_time": now_mono - self._t0,
             "phases": phases,
             "recompiles": {"delta": recompile_delta,
                            "total": self._watcher.total},
@@ -342,8 +358,19 @@ class TelemetryRecorder:
             "scan": self._scan_stats(),
         }
         self._feed_registry(event)
+        # derive the iteration's trace spans (train/iteration parent +
+        # phase children, host-gap decomposition on scan iterations)
+        # from the deltas just computed — the hot path pays nothing new
+        try:
+            from .trace import record_iteration_spans
+            record_iteration_spans(event, self._last_iter_mono,
+                                   now_mono)
+        except Exception:
+            pass
+        self._last_iter_mono = now_mono
         self._drain_fault_events()  # fault lines precede their iteration
         self._drain_compile_events()  # so do the compiles they ran under
+        self._drain_span_events()    # and the spans they were timed by
         self._write_line(event)
         self.events_written += 1
         return event
@@ -427,6 +454,7 @@ def summarize_events(path: str) -> dict:
     compiles: Dict[str, Dict[str, object]] = {}
     fleet_events = 0
     fleet: Optional[Dict[str, object]] = None
+    spans = 0
 
     def _parse(line: str, is_last: bool) -> Optional[dict]:
         try:
@@ -492,6 +520,11 @@ def summarize_events(path: str) -> dict:
             fleet_events += 1
             fleet = {k: v for k, v in ev.items() if k != "event"}
             continue
+        if ev.get("event") == "span":
+            # trace spans are counted here and analyzed by
+            # `lightgbm_tpu trace <dir>` (obs/trace.py)
+            spans += 1
+            continue
         if ev.get("event") != "iteration":
             continue
         iters += 1
@@ -542,7 +575,8 @@ def summarize_events(path: str) -> dict:
             "scan_windows": scan_windows,
             "scan_iterations": scan_iterations,
             "compiles": compiles,
-            "fleet": fleet, "fleet_events": fleet_events}
+            "fleet": fleet, "fleet_events": fleet_events,
+            "spans": spans}
 
 
 #: jit entry point -> Timer phase whose per-call mean is the measured
@@ -672,6 +706,9 @@ def render_stats_table(summary: dict) -> str:
         per_kind = ", ".join(f"{k}={v}" for k, v in sorted(faults.items()))
         lines.append(f"fault events         : {sum(faults.values())} "
                      f"({per_kind})")
+    if summary.get("spans"):
+        lines.append(f"trace spans          : {summary['spans']} "
+                     "(merge: python -m lightgbm_tpu trace <dir>)")
     for key, val in sorted(summary["last_eval"].items()):
         lines.append(f"final {key:15s}: {val:g}")
     phases = summary["phases"]

@@ -154,7 +154,8 @@ class LoadGenerator:
                  rate_per_sec: float = 20.0, rows_per_request: int = 4,
                  reply_timeout: float = 30.0,
                  event_log: Optional[_EventLog] = None,
-                 stats_interval: float = 1.0):
+                 stats_interval: float = 1.0,
+                 trace_every: int = 0):
         self.ports = list(ports)
         self.n_features = int(n_features)
         self.rate = max(0.1, float(rate_per_sec))
@@ -162,6 +163,11 @@ class LoadGenerator:
         self.reply_timeout = float(reply_timeout)
         self.event_log = event_log
         self.stats_interval = max(0.1, float(stats_interval))
+        # distributed tracing (obs/trace.py): every Nth request
+        # originates a trace — its {"trace": ...} protocol field makes
+        # the replica emit queue-wait/batch-window/dispatch spans, and
+        # the client-side span lands in the pipeline's own event log
+        self.trace_every = max(0, int(trace_every))
         self._stop = threading.Event()
         self._lock = threading.Lock()
         # ---- guarded by self._lock ----
@@ -253,6 +259,16 @@ class LoadGenerator:
             rows = [[rng.uniform(-2.0, 2.0)
                      for _ in range(self.n_features)]
                     for _ in range(self.rows)]
+            payload: Dict[str, Any] = {"rows": rows}
+            span_ctx = None
+            if self.trace_every and self.event_log is not None \
+                    and (i - 1) % self.trace_every == 0:
+                from .obs import trace as _trace
+                span_ctx = (_trace.new_trace_id(),
+                            _trace.new_span_id(),
+                            time.perf_counter())
+                payload["trace"] = {"trace_id": span_ctx[0],
+                                    "span_id": span_ctx[1]}
             t0 = time.monotonic()
             want = self.event_log is not None and t0 >= next_stats
             try:
@@ -263,7 +279,7 @@ class LoadGenerator:
                     s.settimeout(self.reply_timeout)
                     fh = s.makefile("rw", encoding="utf-8")
                     conns[port] = fh
-                fh.write(json.dumps({"rows": rows}) + "\n")
+                fh.write(json.dumps(payload) + "\n")
                 fh.flush()
                 line = fh.readline()
                 if not line:
@@ -287,6 +303,19 @@ class LoadGenerator:
                     stats = self._note("ok", latency=dt,
                                        model=reply.get("model"),
                                        want_stats=want)
+                    if span_ctx is not None:
+                        # the root client-side span: written straight
+                        # to the supervisor's event log (no recorder
+                        # drains on this side); the replica's
+                        # serve/request span parents to it
+                        from .obs import trace as _trace
+                        self.event_log.write(_trace.make_span(
+                            "client/request", span_ctx[2],
+                            trace_id=span_ctx[0],
+                            span_id=span_ctx[1],
+                            attrs={"model": reply.get("model"),
+                                   "port": port,
+                                   "outcome": "ok"}))
             if stats is not None:
                 next_stats = time.monotonic() + self.stats_interval
                 self.event_log.write(
@@ -410,6 +439,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "disables the load generator)")
     p.add_argument("--request-rows", type=int, default=4,
                    help="rows per generated request")
+    p.add_argument("--trace-every", type=int,
+                   default=Config.trace_sample_every,
+                   help="originate a distributed trace on every Nth "
+                        "load-generator request: the replica answers "
+                        "with queue-wait/batch-window/dispatch spans "
+                        "joined by `python -m lightgbm_tpu trace` "
+                        "(0 disables trace sampling)")
     p.add_argument("--max-restarts", type=int, default=6,
                    help="restart budget for each supervised side")
     p.add_argument("--max-restarts-per-window", type=int, default=0,
@@ -588,7 +624,17 @@ def _train_worker(args) -> int:
     telem = os.environ.get("LIGHTGBM_TPU_TELEMETRY")
     if telem:
         try:
+            # the publish span was recorded AFTER the recorder closed
+            # (train() returned before publish_model ran): drain it —
+            # and anything else pending — into the same stream
+            from .obs.trace import drain_span_events
+            spans = drain_span_events()
+        except Exception:
+            spans = []
+        try:
             with open(telem, "a", encoding="utf-8") as fh:
+                for ev in spans:
+                    fh.write(json.dumps(ev) + "\n")
                 fh.write(json.dumps(
                     {"event": "publish", **manifest}) + "\n")
         except OSError:
@@ -624,18 +670,31 @@ def _train_generation(args, gen: int, dirs: Dict[str, str],
                       train_faults: str, events: _EventLog) -> int:
     """One generation under the elastic supervisor (in-process call —
     elastic.supervise is jax-free)."""
+    from .obs import trace as _trace
     from .resilience.elastic import supervise
     env = dict(os.environ)
     env["LIGHTGBM_TPU_CHECKPOINT"] = os.path.join(
         dirs["checkpoints"], f"g{gen:04d}")
     env["LIGHTGBM_TPU_TELEMETRY"] = os.path.join(
         dirs["telemetry"], f"train_g{gen:04d}.jsonl")
+    # the generation's trace originates HERE: the workers inherit the
+    # context through the env var, so their iteration spans and the
+    # publisher's publish span (stamped into the manifest, picked up
+    # by the serve watchers) all join this one trace
+    trace_id, span_id = _trace.new_trace_id(), _trace.new_span_id()
+    env[_trace.TRACE_CTX_ENV] = _trace.format_context(trace_id,
+                                                      span_id)
+    # also the supervisor's OWN current context while this generation
+    # runs: the elastic supervisor's restart/world spans join it
+    _trace.set_current_trace(trace_id, span_id)
     if train_faults:
         env["LIGHTGBM_TPU_FAULT_INJECT"] = train_faults
     else:
         env.pop("LIGHTGBM_TPU_FAULT_INJECT", None)
     events.write({"event": "pipeline", "phase": "train_start",
-                  "generation": gen, "time": time.time()})
+                  "generation": gen, "trace_id": trace_id,
+                  "time": time.time()})
+    t0 = time.perf_counter()
     rc = supervise(
         1, _worker_cmd(args, gen), max_restarts=args.max_restarts,
         # per-generation log dir: the fleet supervisor writes the
@@ -650,6 +709,13 @@ def _train_generation(args, gen: int, dirs: Dict[str, str],
         metrics_port=args.metrics_port or None,
         scrape_interval=args.scrape_interval
         if args.metrics_port else 0.0)
+    _trace.record_span("pipeline/train", t0, trace_id=trace_id,
+                       span_id=span_id,
+                       attrs={"generation": gen, "rc": rc})
+    # the supervisor's own spans land in pipeline.jsonl directly —
+    # there is no recorder on this side to drain them
+    for ev in _trace.drain_span_events():
+        events.write(ev)
     events.write({"event": "pipeline", "phase": "train_done",
                   "generation": gen, "rc": rc, "time": time.time()})
     return rc
@@ -821,7 +887,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             loadgen = LoadGenerator(
                 ports, args.features, rate_per_sec=args.request_rate,
                 rows_per_request=args.request_rows,
-                event_log=events)
+                event_log=events, trace_every=args.trace_every)
             loadgen.start()
             client_metrics.attach(loadgen)
         # the bootstrap model was loaded at startup, not hot-swapped:

@@ -235,6 +235,22 @@ class _FleetTelemetry:
             self._file = None
 
 
+def _drain_spans_into(telem: "_FleetTelemetry") -> None:
+    """Restart spans (obs/trace.py) recorded by the supervision loop
+    ride its fleet stream on the scrape cadence, like the scrape
+    records themselves. Without a stream the spans stay buffered
+    (capped) for an embedding caller — the pipeline supervisor calls
+    :func:`supervise` in-process and drains into its own event log."""
+    if telem._file is None:
+        return
+    try:
+        from ..obs.trace import drain_span_events
+        for ev in drain_span_events():
+            telem.write(ev)
+    except Exception:
+        pass
+
+
 def fleet_telemetry_path(env: Optional[Dict[str, str]] = None) \
         -> Optional[str]:
     """Where a supervisor writes its scrape records: the run's
@@ -553,6 +569,7 @@ def supervise(nprocs: int, cmd: Sequence[str], max_restarts: int = 3,
         event = _scrape_world_ranks(nprocs, metrics_port + 1)
         if event is not None:
             telem.write(event)
+        _drain_spans_into(telem)
 
     generation = 0
     consecutive = 0
@@ -595,7 +612,23 @@ def supervise(nprocs: int, cmd: Sequence[str], max_restarts: int = 3,
                  f"/{max_restarts}) in {delay:.2f}s; training resumes "
                  "from the newest checkpoint if LIGHTGBM_TPU_CHECKPOINT "
                  "is set")
+        t_restart = time.perf_counter()
         time.sleep(delay)
+        try:
+            # the restart's backoff IS lifecycle latency: span it so
+            # the merged trace shows where a chaos-killed generation's
+            # wall time went (drained into the fleet stream on the
+            # scrape cadence, or by the embedding pipeline supervisor)
+            from ..obs.trace import current_context, record_span
+            ctx = current_context()
+            record_span("restart/world", t_restart,
+                        trace_id=ctx["trace_id"] if ctx else None,
+                        parent_id=ctx["span_id"] if ctx else None,
+                        attrs={"restart": generation, "rc": rc,
+                               "backoff_s": round(delay, 3)})
+        except Exception:
+            pass
+        _drain_spans_into(telem)
 
 
 class _Replica:
@@ -603,7 +636,7 @@ class _Replica:
 
     __slots__ = ("rank", "proc", "generation", "launched_at",
                  "consecutive_restarts", "ping_failures", "done",
-                 "relaunch_at")
+                 "relaunch_at", "restart_t0")
 
     def __init__(self, rank: int):
         self.rank = rank
@@ -617,6 +650,9 @@ class _Replica:
         # a per-replica NOT-BEFORE time, never an inline sleep — one
         # replica's backoff must not stall supervision of the others
         self.relaunch_at: Optional[float] = None
+        # perf_counter when the death/wedge was observed; closes into
+        # a restart/replica span (obs/trace.py) at relaunch
+        self.restart_t0: Optional[float] = None
 
 
 def _launch_replica(rep: _Replica, cmd: Sequence[str], nprocs: int,
@@ -704,6 +740,7 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
                 next_scrape = now + scrape_interval
                 telem.write(_scrape_fleet(fleet, health_port,
                                           health_timeout))
+                _drain_spans_into(telem)
             for rep in fleet:
                 if rep.done:
                     continue
@@ -715,6 +752,17 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
                         rep.relaunch_at = None
                         _launch_replica(rep, cmd, nprocs, log_dir,
                                         base_env)
+                        if rep.restart_t0 is not None:
+                            t0, rep.restart_t0 = rep.restart_t0, None
+                            try:
+                                from ..obs.trace import record_span
+                                record_span(
+                                    "restart/replica", t0,
+                                    attrs={"rank": rep.rank,
+                                           "generation":
+                                               rep.generation})
+                            except Exception:
+                                pass
                     continue
                 if rep.proc is None:
                     continue
@@ -762,6 +810,7 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
                     rep.consecutive_restarts += 1
                     delay = budget.backoff(rep.consecutive_restarts)
                     rep.relaunch_at = now + delay
+                    rep.restart_t0 = time.perf_counter()
                     log_info(f"elastic: relaunching replica "
                              f"{rep.rank} (generation "
                              f"{rep.generation}) in {delay:.2f}s")
@@ -780,6 +829,7 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
                     # the stream even when the cadence never fired
                     telem.write(_scrape_fleet(fleet, None,
                                               health_timeout))
+                    _drain_spans_into(telem)
                 return 0
             time.sleep(_POLL_SECONDS)
     except BaseException:          # ctrl-C etc.: never leak replicas

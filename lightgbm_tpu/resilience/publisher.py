@@ -97,15 +97,27 @@ def publish_model(model, directory, name: str, *,
     """
     if not isinstance(model, str):
         model = model.model_to_string()
+    t_start = time.perf_counter()
     payload = model.encode("utf-8")
     directory = os.fspath(directory)
     target = os.path.join(directory, name)
+    # trace context (obs/trace.py): inherit the publishing process's
+    # current trace (the pipeline supervisor's per-generation context,
+    # via LIGHTGBM_TPU_TRACE_CTX) or start a fresh one, and stamp it
+    # INTO the manifest — the serve watcher's validate->load->swap
+    # spans then correlate back to the generation that published
+    from ..obs import trace as _trace
+    ctx = _trace.current_context()
+    trace_id = ctx["trace_id"] if ctx else _trace.new_trace_id()
+    parent_id = ctx["span_id"] if ctx else None
+    span_id = _trace.new_span_id()
     manifest = {
         "magic": MANIFEST_MAGIC,
         "file": name,
         "bytes": len(payload),
         "sha256": _sha256_hex(payload),
         "created_unix": time.time(),
+        "trace": {"trace_id": trace_id, "span_id": span_id},
         **(metadata or {}),
     }
     from .faults import FaultPlan, record_fault_event
@@ -148,6 +160,13 @@ def publish_model(model, directory, name: str, *,
             _sleep(delay)
             continue
         _count("publish_total")
+        _trace.record_span(
+            "publish/model", t_start, trace_id=trace_id,
+            span_id=span_id, parent_id=parent_id,
+            attrs={"file": name,
+                   "generation": (metadata or {}).get("generation"),
+                   "sha256": manifest["sha256"][:12],
+                   "attempts": attempt + 1})
         log_info(f"publish: wrote {target} "
                  f"({len(payload)} bytes, sha256 "
                  f"{manifest['sha256'][:12]}…)")

@@ -295,6 +295,13 @@ class ServeState:
             faults = faults + drain_compile_events()
         except Exception:
             pass
+        try:
+            # per-request / swap spans (obs/trace.py) ride the serve
+            # stream on the stats cadence, like faults and compiles
+            from ..obs.trace import drain_span_events
+            faults = faults + drain_span_events()
+        except Exception:
+            pass
         payload = {"event": "serve", **self.stats()}
         with self._lock:
             fh = self._telemetry_file
@@ -376,9 +383,17 @@ def handle_request(obj: Any, state: ServeState) -> Dict[str, Any]:
     # an accepted-but-unanswered request; the dying connection is the
     # client's retry signal
     state.fault_plan.maybe_serve_kill(state.count_request())
+    # optional distributed-tracing context (obs/trace.py): a sampled
+    # client sends {"trace": {"trace_id", "span_id"}} and this request
+    # emits queue-wait / batch-window / dispatch / reply spans into the
+    # serve telemetry stream, parented to the client's span
+    trace_ctx = obj.get("trace")
+    if not isinstance(trace_ctx, dict) \
+            or not trace_ctx.get("trace_id"):
+        trace_ctx = None
     from .batcher import QueueFullError, SheddingError
     try:
-        fut = state.batcher.submit(X)
+        fut = state.batcher.submit(X, trace=trace_ctx)
     except QueueFullError as e:
         return {"error": str(e), "overloaded": True}
     except (ValueError, RuntimeError) as e:
@@ -401,8 +416,40 @@ def handle_request(obj: Any, state: ServeState) -> Dict[str, Any]:
         forest = state.batcher._current_forest()
     out = forest.finalize(raw_scores,
                           raw_score=bool(obj.get("raw", False)))
+    model_id = state.model_id()
+    times = getattr(fut, "trace_times", None)
+    if trace_ctx is not None and times is not None:
+        _record_request_spans(trace_ctx, times, model_id,
+                              int(X.shape[0]))
     return {"predictions": out.tolist(), "n": int(X.shape[0]),
-            "model": state.model_id()}
+            "model": model_id}
+
+
+def _record_request_spans(trace_ctx: Dict[str, Any], times, model_id,
+                          n_rows: int) -> None:
+    """Spans for one sampled request: a ``serve/request`` parent over
+    submit -> reply, with queue-wait / batch-window / device-dispatch /
+    reply children from the batcher's perf_counter checkpoints. Only
+    runs for requests that CARRIED a trace context — never on the
+    default path — and never raises into the reply."""
+    try:
+        from ..obs import trace as _trace
+        t_submit, t_dequeue, t_dispatch, t_done = times
+        now = time.perf_counter()
+        tid = trace_ctx.get("trace_id")
+        parent = _trace.record_span(
+            "serve/request", t_submit, now, trace_id=tid,
+            parent_id=trace_ctx.get("span_id"),
+            attrs={"model": model_id, "rows": n_rows})
+        for name, a, b in (
+                ("serve/queue_wait", t_submit, t_dequeue),
+                ("serve/batch_window", t_dequeue, t_dispatch),
+                ("serve/dispatch", t_dispatch, t_done),
+                ("serve/reply", t_done, now)):
+            _trace.record_span(name, a, b, trace_id=tid,
+                               parent_id=parent)
+    except Exception:
+        pass
 
 
 # ---------------------------------------------------------------------
@@ -552,8 +599,11 @@ class _Watcher:
             # and must be skipped, not served. Unmanaged artifacts
             # (no sidecar) keep the legacy trust-once-it-parses path.
             from ..resilience.publisher import validate_artifact
+            t_poll = time.perf_counter()
             manifest = validate_artifact(path)
+            t_valid = time.perf_counter()
             booster = _load_booster(path)
+            t_load = time.perf_counter()
             from .compile import compile_forest
             old = self.state.batcher._current_forest()
             # stage HOST-side on this thread (no HBM, no serving
@@ -570,6 +620,7 @@ class _Watcher:
             # so attach() can DONATE its device buffers field-by-field
             # to the new upload — the transient HBM overhead is one
             # field, never a second resident forest
+            t_stage = time.perf_counter()
             fut = self.state.batcher.swap_deferred(
                 lambda old_forest: staged.attach(reuse=old_forest))
             try:
@@ -608,6 +659,9 @@ class _Watcher:
         # an optimization and its failure is not a failed swap (the
         # buckets just compile lazily on traffic)
         self.state.note_swap(forest.model_id, path, manifest=manifest)
+        self._record_swap_spans(
+            manifest, path, forest.model_id,
+            (t_poll, t_valid, t_load, t_stage, time.perf_counter()))
         log_info(f"serve: hot-swapped model from {path} "
                  f"(id {forest.model_id})")
         if self.warmup_rows != 0:
@@ -617,6 +671,32 @@ class _Watcher:
                 log_warning(f"serve: post-swap warmup failed ({e}); "
                             "buckets will compile on demand")
         return True
+
+    @staticmethod
+    def _record_swap_spans(manifest, path: str, model_id,
+                           times) -> None:
+        """validate -> load -> stage -> apply spans for one successful
+        hot swap. The publisher stamped its trace context into the
+        manifest (``manifest["trace"]``), so the swap correlates back
+        to the publishing generation's trace; an unmanaged artifact
+        (no manifest) gets a fresh trace id. Never raises — tracing
+        must not fail a completed swap."""
+        try:
+            from ..obs import trace as _trace
+            ctx = (manifest or {}).get("trace") or {}
+            tid = ctx.get("trace_id") or _trace.new_trace_id()
+            parent = ctx.get("span_id")
+            t_poll, t_valid, t_load, t_stage, t_apply = times
+            for name, a, b, attrs in (
+                    ("swap/validate", t_poll, t_valid, None),
+                    ("swap/load", t_valid, t_load, None),
+                    ("swap/stage", t_load, t_stage, None),
+                    ("swap/apply", t_stage, t_apply,
+                     {"model": model_id, "path": path})):
+                _trace.record_span(name, a, b, trace_id=tid,
+                                   parent_id=parent, attrs=attrs)
+        except Exception:
+            pass
 
 
 class _StatsLoop:

@@ -33,7 +33,8 @@ from typing import Dict, Iterator
 
 from .log import log_info
 
-__all__ = ["Timer", "timed", "trace_to"]
+__all__ = ["Timer", "timed", "trace_to", "EnvCapture",
+           "parse_xprof_spec"]
 
 # number of live trace_to() captures; touched under Timer._lock
 _tracing = 0
@@ -186,3 +187,93 @@ def trace_to(log_dir: str) -> Iterator[None]:
     finally:
         with Timer._lock:
             _tracing -= 1
+
+
+def parse_xprof_spec(spec: str):
+    """Parse ``LIGHTGBM_TPU_XPROF=<dir>:iters=A-B`` (or ``:iters=A``
+    for a one-iteration window) into ``(log_dir, first, last)``.
+    Raises ValueError on a malformed spec — a silently ignored typo
+    would cost an on-chip session its capture."""
+    if ":iters=" not in spec:
+        raise ValueError(
+            f"LIGHTGBM_TPU_XPROF expects <dir>:iters=A-B, got "
+            f"{spec!r}")
+    log_dir, window = spec.rsplit(":iters=", 1)
+    lo, _, hi = window.partition("-")
+    try:
+        first = int(lo)
+        last = int(hi) if hi else first
+    except ValueError:
+        raise ValueError(
+            f"LIGHTGBM_TPU_XPROF iteration window {window!r} is not "
+            "A-B integers") from None
+    if not log_dir or first < 0 or last < first:
+        raise ValueError(
+            f"LIGHTGBM_TPU_XPROF window {spec!r} needs a directory "
+            "and 0 <= A <= B")
+    return log_dir, first, last
+
+
+class EnvCapture:
+    """Env-driven device captures for the train loop (engine.py):
+
+    - ``LIGHTGBM_TPU_TRACE_TO=<dir>`` wraps the WHOLE iteration loop
+      in one :func:`trace_to` capture — device profiles reachable
+      without any API calls,
+    - ``LIGHTGBM_TPU_XPROF=<dir>:iters=A-B`` captures only iterations
+      A..B (engine-absolute): the programmatic window that makes a
+      steady-state fused-scan iteration inspectable without paying a
+      whole-run xplane file.
+
+    The engine calls ``before_iteration(i)`` / ``after_iteration(i)``
+    per iteration and ``close()`` in its finally; every call is a
+    no-op (two integer compares) outside the configured windows, and
+    :meth:`from_env` returns None when neither knob is set, so an
+    untraced run never even takes the per-iteration calls."""
+
+    def __init__(self, trace_dir=None, xprof=None, _tracer=None):
+        self._trace_dir = trace_dir
+        self._xprof = xprof                     # (dir, first, last)
+        self._tracer = _tracer or trace_to
+        self._whole = None
+        self._window = None
+
+    @classmethod
+    def from_env(cls, env=None):
+        env = os.environ if env is None else env
+        trace_dir = env.get("LIGHTGBM_TPU_TRACE_TO") or None
+        spec = env.get("LIGHTGBM_TPU_XPROF") or None
+        xprof = parse_xprof_spec(spec) if spec else None
+        if trace_dir is None and xprof is None:
+            return None
+        return cls(trace_dir=trace_dir, xprof=xprof)
+
+    def _enter(self, log_dir):
+        cm = self._tracer(log_dir)
+        cm.__enter__()
+        return cm
+
+    def before_iteration(self, i: int) -> None:
+        if self._trace_dir is not None and self._whole is None:
+            self._whole = self._enter(self._trace_dir)
+        if self._xprof is not None and self._window is None \
+                and i == self._xprof[1]:
+            self._window = self._enter(self._xprof[0])
+
+    def after_iteration(self, i: int) -> None:
+        if self._window is not None and i >= self._xprof[2]:
+            cm, self._window = self._window, None
+            self._xprof = None     # one window per run, never re-armed
+            cm.__exit__(None, None, None)
+
+    def close(self) -> None:
+        """Idempotent; runs on the engine's finally so an exception
+        mid-window still finalizes the capture files."""
+        for attr in ("_window", "_whole"):
+            cm = getattr(self, attr)
+            if cm is not None:
+                setattr(self, attr, None)
+                try:
+                    cm.__exit__(None, None, None)
+                except Exception:
+                    pass

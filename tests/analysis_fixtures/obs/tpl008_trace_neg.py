@@ -1,0 +1,49 @@
+# tpulint fixture: TPL008 negative — the same span recorder as
+# obs/tpl008_trace_pos.py with every touch of the buffer, the drop
+# counter and the current-trace cell under ONE _spans_lock common to
+# the recording threads and the drain thread (the locked
+# snapshot-and-clear contract of obs/trace.py). No EXPECT lines.
+import threading
+
+_spans_lock = threading.Lock()
+_spans = []
+_spans_dropped = 0
+_current = None
+_SPANS_CAP = 4096
+
+
+def record_span(name, dur):
+    global _spans_dropped
+    ev = {"event": "span", "name": name, "dur": dur}
+    with _spans_lock:
+        if len(_spans) < _SPANS_CAP:
+            _spans.append(ev)
+        else:
+            _spans_dropped += 1
+    return ev
+
+
+def set_current_trace(trace_id):
+    global _current
+    with _spans_lock:
+        _current = trace_id
+
+
+def _drain_loop(sink):
+    while True:
+        global _spans_dropped
+        with _spans_lock:
+            out = list(_spans)
+            _spans.clear()
+            _spans_dropped = 0
+        for ev in out:
+            sink(ev)
+
+
+def start(sink):
+    threading.Thread(target=_drain_loop, args=(sink,),
+                     daemon=True).start()
+    threading.Thread(target=record_span, args=("serve/request", 0.01),
+                     daemon=True).start()
+    set_current_trace("t" * 16)
+    return record_span("train/iteration", 0.1)

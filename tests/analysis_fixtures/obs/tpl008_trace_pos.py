@@ -1,0 +1,40 @@
+# tpulint fixture: TPL008 positive — a span recorder (the obs/trace.py
+# shape) whose buffer is appended from request/trainer threads and
+# snapshot-and-cleared from a recorder drain thread, with NO lock on
+# either side. This is the strip-the-span-lock acceptance shape:
+# obs/tpl008_trace_neg.py is the same recorder WITH _spans_lock, and
+# removing it must re-surface these findings.
+import threading
+
+_spans = []           # span buffer, shared with the drain thread
+_spans_dropped = 0
+_SPANS_CAP = 4096
+
+
+def record_span(name, dur):
+    global _spans_dropped
+    ev = {"event": "span", "name": name, "dur": dur}
+    if len(_spans) < _SPANS_CAP:
+        # EXPECT: TPL008
+        _spans.append(ev)
+    else:
+        # EXPECT: TPL008
+        _spans_dropped += 1
+    return ev
+
+
+def _drain_loop(sink):
+    while True:
+        out = list(_spans)
+        # EXPECT: TPL008
+        _spans.clear()
+        for ev in out:
+            sink(ev)
+
+
+def start(sink):
+    threading.Thread(target=_drain_loop, args=(sink,),
+                     daemon=True).start()
+    threading.Thread(target=record_span, args=("serve/request", 0.01),
+                     daemon=True).start()
+    return record_span("train/iteration", 0.1)

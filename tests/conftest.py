@@ -51,6 +51,13 @@ def _isolate_process_fault_log():
     # unrelated later test's JSONL stream
     from lightgbm_tpu.obs.cost import drain_compile_events
     drain_compile_events()
+    # and for the process-level span buffer + current trace context
+    # (obs/trace.py): spans recorded without an attached recorder must
+    # not leak into a later test's stream, and a test that calls
+    # set_current_trace must not re-parent spans of the next test
+    from lightgbm_tpu.obs.trace import drain_span_events, set_current_trace
+    drain_span_events()
+    set_current_trace(None)
 
 
 @pytest.fixture(scope="session")

@@ -854,3 +854,43 @@ def test_pipeline_chaos_end_to_end(tmp_path):
     fleet_logs = sorted(os.listdir(
         os.path.join(workdir, "logs", "fleet")))
     assert "elastic_g1_rank0.log" in fleet_logs, fleet_logs
+
+    # --- tracing plane (ISSUE 16 acceptance): the same chaos run's
+    # telemetry merges into a clock-corrected trace with a full
+    # train -> publish -> swap -> serve critical path, despite the
+    # SIGKILLed replica's truncated stream
+    telem_dir = os.path.join(workdir, "telemetry")
+    tr = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "trace", telem_dir],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=REPO_DIR)
+    assert tr.returncode == 0, (
+        f"trace CLI failed:\n{tr.stdout}\n{tr.stderr[-3000:]}")
+    assert "critical path" in tr.stdout, tr.stdout
+    with open(os.path.join(telem_dir, "trace.json")) as fh:
+        doc = json.load(fh)
+    xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert xs, "empty Perfetto export from the chaos run"
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+    span_names = {e["name"] for e in xs}
+    for expected in ("train/iteration", "publish/model",
+                     "swap/apply", "serve/request"):
+        assert expected in span_names, sorted(span_names)
+
+    from lightgbm_tpu.obs.trace import (correct_clock_skew,
+                                        critical_paths, load_spans)
+    spans = load_spans(telem_dir)
+    offsets = correct_clock_skew(spans)
+    assert len(offsets) >= 3  # trainer(s), replica, supervisor
+    paths = critical_paths(spans)
+    complete = [p for p in paths if p["complete"]]
+    assert complete, [
+        {"gen": p["generation"],
+         "steps": [s["name"] for s in p["steps"]]} for p in paths]
+    for p in complete:
+        assert all(s["dur_s"] >= 0 for s in p["steps"]), p["steps"]
+        t0s = [s["t0"] for s in p["steps"]]
+        assert t0s == sorted(t0s), p["steps"]
+        assert 0 < p["total_s"] < 600, p
+        names = [s["name"] for s in p["steps"]]
+        assert names[-1].startswith("serve/request"), names

@@ -183,6 +183,7 @@ _FIXTURES = [
     "obs/tpl008_pos.py", "obs/tpl008_neg.py",
     "obs/tpl008_pragma.py",
     "obs/tpl008_export_pos.py", "obs/tpl008_export_neg.py",
+    "obs/tpl008_trace_pos.py", "obs/tpl008_trace_neg.py",
     "serve/tpl008_pos.py", "serve/tpl008_neg.py",
     "pipeline/tpl006_pos.py", "pipeline/tpl006_neg.py",
     "pipeline/tpl008_pos.py", "pipeline/tpl008_neg.py",
@@ -608,6 +609,64 @@ def test_stripping_the_export_lock_fails(tmp_path):
     assert ("TPL008:obs/export.py:"
             "MetricsHTTPServer.__init__._Handler.do_GET:"
             "shared:_scrape_counts#1") in fids, fids
+
+
+def test_stripping_the_span_buffer_lock_fails(tmp_path):
+    """Tracing-plane acceptance mutation (ISSUE 16): strip
+    ``_spans_lock`` from the span recorder's buffered append
+    (obs/trace.py record_span) -> TPL008 names the buffer. The
+    mutated copy is linted TOGETHER with the unmodified serve daemon,
+    whose request-handler and hot-swap watcher threads put
+    record_span on the thread side of the call graph."""
+    import shutil
+    anchor = ("    with _spans_lock:\n"
+              "        if len(_spans) < _SPANS_CAP:\n")
+    with open(os.path.join(PKG, "obs", "trace.py"),
+              encoding="utf-8") as fh:
+        src = fh.read()
+    mutated = src.replace(
+        anchor, "    if True:\n        if len(_spans) < _SPANS_CAP:\n")
+    assert mutated != src, "mutation did not apply to obs/trace.py"
+    for rel in ("serve/daemon.py", "serve/batcher.py"):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(PKG, rel), dst)
+    dst = tmp_path / "obs" / "trace.py"
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_text(mutated, encoding="utf-8")
+    res = run_lint(root=str(tmp_path), package="lightgbm_tpu",
+                   files=["obs/trace.py", "serve/daemon.py",
+                          "serve/batcher.py"],
+                   baseline_path="", rules=["TPL008"])
+    fids = [f.fid for f in res.findings]
+    assert ("TPL008:obs/trace.py:record_span:shared:_spans#1"
+            in fids), fids
+    assert ("TPL008:obs/trace.py:record_span:shared:"
+            "_spans_dropped#1" in fids), fids
+
+
+def test_tracing_plane_is_thread_and_lock_clean():
+    """The shipped tracing plane lints clean for the thread/lock
+    rules: every touch of the span buffer and the current-trace cell
+    rides _spans_lock, and the span-recording daemon/watcher paths
+    carry their own guards."""
+    res = run_lint(root=PKG, rules=["TPL006", "TPL008"],
+                   baseline_path=BASELINE,
+                   files=["obs/trace.py", "obs/recorder.py",
+                          "serve/daemon.py", "serve/batcher.py"])
+    assert not res.findings, [f.fid for f in res.findings]
+
+
+def test_hot_drivers_stay_clock_free_with_tracing_on():
+    """TPL002 (host syncs/clock reads in hot-marked drivers) must
+    stay clean with the tracing plane wired in: per-iteration spans
+    are derived in the telemetry recorder from Timer deltas the hot
+    path already pays for — never from clock reads inside the
+    hot-marked iteration drivers."""
+    res = run_lint(root=PKG, rules=["TPL002"], baseline_path=BASELINE,
+                   files=["models/gbdt.py", "engine.py",
+                          "obs/trace.py"])
+    assert not res.findings, [f.fid for f in res.findings]
 
 
 def test_metrics_plane_is_thread_and_lock_clean():

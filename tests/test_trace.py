@@ -1,0 +1,582 @@
+"""Distributed tracing plane (ISSUE 16, obs/trace.py,
+docs/OBSERVABILITY.md "Tracing").
+
+Layers under test:
+
+1. Span recorder: record/drain contract, buffer cap + drop counter,
+   the ``{"event": "span"}`` schema, context propagation (explicit,
+   env-inherited, and the ``span()`` context manager).
+2. Per-iteration derivation: ``record_iteration_spans`` turns one
+   telemetry iteration event into a ``train/iteration`` parent plus
+   sequential ``phase/*`` children, with the fused-scan host-gap
+   decomposition on scan iterations.
+3. The ``python -m lightgbm_tpu trace`` CLI: stream merging across
+   ``.rankN``/``.fleet`` suffixes, truncated-final-line tolerance vs
+   mid-file corruption, cross-process clock-skew correction against
+   synthetic skewed streams, Chrome trace-event (Perfetto) export
+   schema, named critical-path reconstruction, and the jax-free
+   subprocess proof.
+4. Propagation through the serve protocol: a request's ``trace``
+   field becomes a ``serve/request`` parent with queue-wait /
+   batch-window / dispatch / reply children.
+5. Env-driven device captures (utils/timer.py EnvCapture):
+   ``LIGHTGBM_TPU_TRACE_TO`` whole-run and ``LIGHTGBM_TPU_XPROF``
+   iteration-window wiring, plus ``timed()`` staying a shared no-op
+   outside any capture.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tests._mp_utils import REPO_DIR  # noqa: E402
+
+from lightgbm_tpu.obs import trace as T  # noqa: E402
+
+
+# ---------------------------------------------------------------------
+# helpers: fabricate span dicts / streams with controlled clocks
+# ---------------------------------------------------------------------
+
+def _span(name, mono, dur, *, wall_offset=1_000_000.0, proc="pidX",
+          trace_id="t" * 16, span_id=None, parent_id=None, attrs=None):
+    """A raw span event whose wall clock is ``mono + wall_offset`` —
+    i.e. a process whose monotonic origin sits ``wall_offset`` seconds
+    before the shared wall clock."""
+    return {"event": "span", "name": name, "trace_id": trace_id,
+            "span_id": span_id or T.new_span_id(),
+            "parent_id": parent_id, "wall": mono + wall_offset,
+            "mono": mono, "dur": dur, "proc": proc,
+            "attrs": attrs or {}}
+
+
+def _write_stream(path, events, *, truncate_tail=None):
+    with open(path, "w", encoding="utf-8") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+        if truncate_tail is not None:
+            fh.write(truncate_tail)  # no newline: mid-write crash
+
+
+# ---------------------------------------------------------------------
+# 1. span recorder basics
+# ---------------------------------------------------------------------
+
+def test_record_span_schema_and_drain():
+    sid = T.record_span("unit/one", 1.0, 2.0,
+                        trace_id="a" * 16, attrs={"k": 1})
+    pending = T.span_events_snapshot()
+    assert len(pending) == 1
+    ev = pending[0]
+    assert tuple(ev.keys()) == T.SPAN_EVENT_KEYS
+    assert ev["event"] == "span"
+    assert ev["span_id"] == sid
+    assert ev["trace_id"] == "a" * 16
+    assert ev["dur"] == pytest.approx(1.0)
+    assert ev["attrs"] == {"k": 1}
+    assert ev["proc"].startswith("pid")
+    # wall/mono are a paired anchor at span start
+    assert ev["mono"] == 1.0
+    assert ev["wall"] > 0
+    drained = T.drain_span_events()
+    assert [e["span_id"] for e in drained] == [sid]
+    assert T.drain_span_events() == []
+    assert T.span_events_snapshot() == []
+
+
+def test_buffer_cap_drops_then_drain_resets(monkeypatch):
+    monkeypatch.setattr(T, "_SPANS_CAP", 8)
+    for i in range(12):
+        T.record_span("unit/cap", 0.0, 0.1, attrs={"i": i})
+    assert len(T.span_events_snapshot()) == 8
+    assert T._spans_dropped == 4
+    assert len(T.drain_span_events()) == 8
+    assert T._spans_dropped == 0
+    # a fresh append after the drain lands again
+    T.record_span("unit/after", 0.0, 0.1)
+    assert len(T.drain_span_events()) == 1
+
+
+def test_span_contextmanager_inherits_current_context():
+    T.set_current_trace("b" * 16, "c" * 16)
+    with T.span("unit/child") as h:
+        assert h.trace_id == "b" * 16
+        assert h.parent_id == "c" * 16
+        h.attrs["extra"] = True
+    (ev,) = T.drain_span_events()
+    assert ev["trace_id"] == "b" * 16
+    assert ev["parent_id"] == "c" * 16
+    assert ev["attrs"] == {"extra": True}
+    assert ev["dur"] >= 0.0
+
+
+def test_span_contextmanager_roots_fresh_trace_without_context():
+    T.set_current_trace(None)
+    with T.span("unit/root"):
+        pass
+    (ev,) = T.drain_span_events()
+    assert len(ev["trace_id"]) == 16
+    assert ev["parent_id"] is None
+
+
+def test_context_inherited_from_env(monkeypatch):
+    monkeypatch.setenv(T.TRACE_CTX_ENV,
+                       T.format_context("d" * 16, "e" * 16))
+    monkeypatch.setattr(T, "_current", False)  # force re-parse
+    ctx = T.current_context()
+    assert ctx == {"trace_id": "d" * 16, "span_id": "e" * 16}
+
+
+def test_context_env_malformed_is_absent(monkeypatch):
+    monkeypatch.setenv(T.TRACE_CTX_ENV, "not-a-context")
+    monkeypatch.setattr(T, "_current", False)
+    assert T.current_context() is None
+
+
+# ---------------------------------------------------------------------
+# 2. per-iteration span derivation
+# ---------------------------------------------------------------------
+
+def test_record_iteration_spans_phases_and_parenting():
+    T.set_current_trace("f" * 16, "9" * 16)
+    event = {"iteration": 3,
+             "phases": {"hist/build": {"total": 0.010, "count": 4},
+                        "split/find": {"total": 0.020, "count": 4},
+                        "zero/skip": {"total": 0.0, "count": 0}}}
+    T.record_iteration_spans(event, 100.0, 100.05)
+    evs = T.drain_span_events()
+    parent = evs[0]
+    assert parent["name"] == "train/iteration"
+    assert parent["trace_id"] == "f" * 16
+    assert parent["parent_id"] == "9" * 16
+    assert parent["attrs"]["iteration"] == 3
+    assert "host_gap_s" not in parent["attrs"]  # not a scan iteration
+    kids = evs[1:]
+    assert [k["name"] for k in kids] == ["phase/hist/build",
+                                         "phase/split/find"]
+    assert all(k["parent_id"] == parent["span_id"] for k in kids)
+    # sequential layout: children tile [t_start, ...) back to back
+    assert kids[0]["mono"] == pytest.approx(100.0)
+    assert kids[1]["mono"] == pytest.approx(100.010)
+
+
+def test_record_iteration_spans_scan_host_gap():
+    T.set_current_trace(None)
+    event = {"iteration": 7, "scan": {"window": 8},
+             "phases": {T.FUSED_SCAN_PHASE:
+                        {"total": 0.080, "count": 1}}}
+    T.record_iteration_spans(event, 0.0, 0.1)
+    evs = T.drain_span_events()
+    parent = evs[0]
+    assert parent["attrs"]["scan"] == {"window": 8}
+    # iteration wall 100ms minus 80ms blocking fused_scan = 20ms gap
+    assert parent["attrs"]["host_gap_s"] == pytest.approx(0.02)
+    # a bare run (no pipeline context) still groups under ONE trace
+    assert len(parent["trace_id"]) == 16
+
+
+def test_fused_scan_phase_is_single_source_of_truth():
+    # gbdt.py times its window dispatch under this exact label; the
+    # host-gap derivation subtracts it — both import from trace.py
+    from lightgbm_tpu.obs.trace import BLOCKING_PHASES, FUSED_SCAN_PHASE
+    assert FUSED_SCAN_PHASE == "boosting/fused_scan"
+    assert FUSED_SCAN_PHASE in BLOCKING_PHASES
+    src = open(os.path.join(
+        REPO_DIR, "lightgbm_tpu", "models", "gbdt.py")).read()
+    assert "timed(FUSED_SCAN_PHASE)" in src
+
+
+# ---------------------------------------------------------------------
+# 3. trace CLI: loading, skew correction, export, critical paths
+# ---------------------------------------------------------------------
+
+def test_load_spans_walks_fleet_suffixes_and_tolerates_tail(tmp_path):
+    _write_stream(tmp_path / "run.jsonl",
+                  [_span("a", 1.0, 0.1),
+                   {"event": "iteration", "iteration": 0}],
+                  truncate_tail='{"event": "span", "name": "cut')
+    _write_stream(tmp_path / "run.jsonl.rank1", [_span("b", 2.0, 0.1)])
+    _write_stream(tmp_path / "run.jsonl.fleet", [_span("c", 3.0, 0.1)])
+    (tmp_path / "notes.txt").write_text("not telemetry\n")
+    sub = tmp_path / "serve"
+    sub.mkdir()
+    _write_stream(sub / "replica.jsonl", [_span("d", 4.0, 0.1)])
+    spans = T.load_spans(str(tmp_path))
+    got = sorted((s["name"], s["_stream"]) for s in spans)
+    assert got == [("a", "run.jsonl"), ("b", "run.jsonl.rank1"),
+                   ("c", "run.jsonl.fleet"),
+                   ("d", os.path.join("serve", "replica.jsonl"))]
+
+
+def test_load_spans_mid_file_garbage_raises(tmp_path):
+    with open(tmp_path / "bad.jsonl", "w") as fh:
+        fh.write("{ corrupt not json }\n")
+        fh.write(json.dumps(_span("x", 1.0, 0.1)) + "\n")
+    with pytest.raises(ValueError, match="malformed telemetry"):
+        T.load_spans(str(tmp_path))
+
+
+def test_clock_skew_correction_synthetic_streams(tmp_path):
+    # trainer's monotonic origin is 1e6 s behind wall; the serve
+    # replica restarted recently, its origin only 500 s behind — raw
+    # mono values are wildly incomparable (publish mono 2000 vs swap
+    # mono 7.0) but the corrected timeline must order them properly
+    _write_stream(tmp_path / "train.jsonl", [
+        _span("publish/model", 2000.0, 0.05,
+              wall_offset=1_000_000.0, proc="pid1")])
+    _write_stream(tmp_path / "serve.jsonl", [
+        _span("swap/apply", 7.0, 0.02,
+              wall_offset=1_001_993.25, proc="pid2")])
+    spans = T.load_spans(str(tmp_path))
+    offsets = T.correct_clock_skew(spans)
+    assert len(offsets) == 2
+    pub = next(s for s in spans if s["name"] == "publish/model")
+    swap = next(s for s in spans if s["name"] == "swap/apply")
+    # publish ends wall 1_002_000.05; swap starts wall 1_002_000.25
+    gap = swap["t0"] - pub["t1"]
+    assert gap == pytest.approx(0.2, abs=1e-6)
+    assert swap["t1"] > swap["t0"] > pub["t1"] > pub["t0"]
+
+
+def test_clock_skew_median_rejects_ntp_step():
+    # one span's wall clock stepped 30 s mid-run; the median offset
+    # must stick with the majority, not split the difference
+    spans = [_span(f"s{i}", 10.0 + i, 0.01, wall_offset=100.0,
+                   proc="p")
+             for i in range(5)]
+    spans.append(_span("stepped", 20.0, 0.01, wall_offset=130.0,
+                       proc="p"))
+    for s in spans:
+        s["_stream"] = "x.jsonl"
+    offsets = T.correct_clock_skew(spans)
+    assert offsets[("x.jsonl", "p")] == pytest.approx(100.0)
+
+
+def test_chrome_trace_schema(tmp_path):
+    spans = [_span("train/iteration", 1.0, 0.1, proc="p1"),
+             _span("serve/request", 2.0, 0.05, proc="p2")]
+    spans[0]["_stream"] = "a.jsonl"
+    spans[1]["_stream"] = "b.jsonl"
+    T.correct_clock_skew(spans)
+    doc = T.chrome_trace(spans)
+    assert doc["displayTimeUnit"] == "ms"
+    evs = doc["traceEvents"]
+    metas = [e for e in evs if e["ph"] == "M"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(metas) == 2 and len(xs) == 2
+    assert all(m["name"] == "process_name" for m in metas)
+    assert {m["pid"] for m in metas} == {1, 2}
+    assert min(e["ts"] for e in xs) == 0.0  # viewer opens at t=0
+    for e in xs:
+        assert e["dur"] > 0 and e["ts"] >= 0  # microseconds
+        assert e["cat"] in ("train", "serve")
+        assert "trace_id" in e["args"] and "span_id" in e["args"]
+    assert T.chrome_trace([]) == {"traceEvents": [],
+                                  "displayTimeUnit": "ms"}
+
+
+def _lifecycle_streams(tmp_path, *, serve_wall_offset=2_000.0):
+    """Synthetic 3-process lifecycle: trainer (iterations + publish),
+    serve replica (swap steps), client (request riding its OWN
+    trace, joined by model id)."""
+    tid = "11" * 8
+    pub_sid = "22" * 8
+    _write_stream(tmp_path / "train.jsonl", [
+        _span("train/iteration", 100.0, 0.1, trace_id=tid,
+              proc="pid10", attrs={"iteration": 4}),
+        _span("train/iteration", 100.2, 0.1, trace_id=tid,
+              proc="pid10", attrs={"iteration": 5}),
+        _span("publish/model", 100.4, 0.05, trace_id=tid,
+              span_id=pub_sid, proc="pid10",
+              attrs={"generation": 2, "file": "m2.txt"})])
+    swap = [("swap/validate", 0.50), ("swap/load", 0.56),
+            ("swap/stage", 0.62), ("swap/apply", 0.68)]
+    _write_stream(tmp_path / "serve.jsonl", [
+        _span(name, 7.0 + dt, 0.04, trace_id=tid, parent_id=pub_sid,
+              wall_offset=1_000_093.4 + serve_wall_offset, proc="pid20",
+              attrs={"model": "gen2"} if name == "swap/apply" else None)
+        for name, dt in swap])
+    _write_stream(tmp_path / "client.jsonl", [
+        _span("serve/request", 8.1, 0.01, trace_id="33" * 8,
+              wall_offset=1_000_093.4 + serve_wall_offset, proc="pid20",
+              attrs={"model": "gen2", "rows": 4})])
+    return tid
+
+
+def test_critical_path_reconstruction(tmp_path):
+    tid = _lifecycle_streams(tmp_path)
+    spans = T.load_spans(str(tmp_path))
+    T.correct_clock_skew(spans)
+    (path,) = T.critical_paths(spans)
+    assert path["trace_id"] == tid
+    assert path["generation"] == 2
+    assert path["model"] == "gen2"
+    assert path["complete"] is True
+    names = [s["name"] for s in path["steps"] if not s["gap"]]
+    assert names == ["train/iteration #5", "publish/model",
+                     "swap/validate", "swap/load", "swap/stage",
+                     "swap/apply", "serve/request (model gen2)"]
+    # every step and the total carry POSITIVE clock-corrected times
+    assert all(s["dur_s"] >= 0 for s in path["steps"])
+    assert path["total_s"] > 0
+    # steps are monotone on the corrected timeline
+    t0s = [s["t0"] for s in path["steps"]]
+    assert t0s == sorted(t0s)
+    text = T.render_critical_paths([path])
+    assert "critical path" in text and "generation 2" in text
+    assert "INCOMPLETE" not in text
+
+
+def test_critical_path_incomplete_without_serve(tmp_path):
+    _write_stream(tmp_path / "train.jsonl", [
+        _span("train/iteration", 1.0, 0.1, attrs={"iteration": 0}),
+        _span("publish/model", 1.2, 0.05, attrs={"generation": 0})])
+    spans = T.load_spans(str(tmp_path))
+    T.correct_clock_skew(spans)
+    (path,) = T.critical_paths(spans)
+    assert path["complete"] is False
+    assert "INCOMPLETE" in T.render_critical_paths([path])
+
+
+def test_trace_cli_end_to_end(tmp_path, capsys):
+    _lifecycle_streams(tmp_path)
+    assert T.main([str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "Perfetto" in out
+    assert "clock-skew correction" in out
+    assert "critical path" in out
+    doc = json.load(open(tmp_path / "trace.json"))
+    assert doc["traceEvents"]
+    # --out redirects the export
+    alt = tmp_path / "alt.json"
+    assert T.main([str(tmp_path), "--out", str(alt)]) == 0
+    assert json.load(open(alt))["traceEvents"]
+
+
+def test_trace_cli_error_paths(tmp_path, capsys):
+    assert T.main(["--help"]) == 0
+    assert "usage: python -m lightgbm_tpu trace" in \
+        capsys.readouterr().out
+    assert T.main([]) == 1
+    assert T.main([str(tmp_path / "missing")]) == 1
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert T.main([str(empty)]) == 1  # no spans
+    assert T.main([str(tmp_path), "--out"]) == 1  # dangling flag
+
+
+def test_trace_cli_is_jax_free(tmp_path):
+    """`python -m lightgbm_tpu trace` must never import jax — it
+    post-processes JSONL where no backend may initialize."""
+    d = tmp_path / "telem"
+    d.mkdir()
+    _write_stream(d / "t.jsonl",
+                  [_span("train/iteration", 1.0, 0.1,
+                         attrs={"iteration": 0})])
+    code = (
+        "import sys\n"
+        "from lightgbm_tpu.obs.trace import main\n"
+        f"rc = main([{str(d)!r}])\n"
+        "assert rc == 0, rc\n"
+        "assert 'jax' not in sys.modules, 'trace CLI imported jax!'\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO_DIR,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\nstdout: {proc.stdout[-2000:]}\n"
+        f"stderr: {proc.stderr[-2000:]}")
+
+
+# ---------------------------------------------------------------------
+# 4. propagation through the serve protocol + publisher manifest
+# ---------------------------------------------------------------------
+
+class _DummyForest:
+    n_features = 3
+    model_id = "dummy-1"
+
+    def predict_raw(self, X):
+        return np.zeros((X.shape[0], 1), np.float32)
+
+    def finalize(self, raw, raw_score=False):
+        return raw[:, 0]
+
+
+def test_serve_protocol_span_propagation():
+    from lightgbm_tpu.serve.batcher import MicroBatcher
+    from lightgbm_tpu.serve.daemon import ServeState, handle_request
+    b = MicroBatcher(_DummyForest(), batch_window_ms=0.5)
+    state = ServeState(b, "dummy-1", "mem")
+    try:
+        # untraced request: zero span cost
+        r = handle_request({"rows": [[1, 2, 3]]}, state)
+        assert "predictions" in r
+        assert T.drain_span_events() == []
+        # traced request: serve/request parent + the 4 stage children
+        r = handle_request({"rows": [[1, 2, 3], [4, 5, 6]],
+                            "trace": {"trace_id": "a1" * 8,
+                                      "span_id": "b2" * 8}}, state)
+        assert "predictions" in r
+        evs = T.drain_span_events()
+        assert [e["name"] for e in evs] == [
+            "serve/request", "serve/queue_wait", "serve/batch_window",
+            "serve/dispatch", "serve/reply"]
+        parent = evs[0]
+        assert parent["trace_id"] == "a1" * 8
+        assert parent["parent_id"] == "b2" * 8
+        assert parent["attrs"] == {"model": "dummy-1", "rows": 2}
+        assert all(e["parent_id"] == parent["span_id"]
+                   and e["trace_id"] == "a1" * 8 for e in evs[1:])
+        assert all(e["dur"] >= 0 for e in evs)
+        # a malformed trace field is ignored, not fatal
+        r = handle_request({"rows": [[1, 2, 3]], "trace": "bogus"},
+                           state)
+        assert "predictions" in r
+        assert T.drain_span_events() == []
+    finally:
+        b.close()
+        state.close()
+
+
+def test_publisher_stamps_trace_context_into_manifest(tmp_path):
+    from lightgbm_tpu.resilience.publisher import publish_model
+    T.set_current_trace("77" * 8, "88" * 8)
+    manifest = publish_model("tree\nend of trees\n", str(tmp_path),
+                             "m0.txt", metadata={"generation": 0})
+    assert manifest["trace"]["trace_id"] == "77" * 8
+    evs = T.drain_span_events()
+    (pub,) = [e for e in evs if e["name"] == "publish/model"]
+    assert pub["trace_id"] == "77" * 8
+    assert pub["span_id"] == manifest["trace"]["span_id"]
+    assert pub["parent_id"] == "88" * 8
+    assert pub["attrs"]["generation"] == 0
+    assert pub["attrs"]["attempts"] == 1
+    # a manifest published OUTSIDE any trace still self-identifies
+    T.set_current_trace(None)
+    manifest = publish_model("tree\nend of trees\n", str(tmp_path),
+                             "m1.txt")
+    assert len(manifest["trace"]["trace_id"]) == 16
+    T.drain_span_events()
+
+
+def test_summarize_events_counts_spans(tmp_path):
+    from lightgbm_tpu.obs import render_stats_table, summarize_events
+    path = str(tmp_path / "t.jsonl")
+    _write_stream(path, [_span("a", 1.0, 0.1), _span("b", 2.0, 0.1)])
+    summ = summarize_events(path)
+    assert summ["spans"] == 2
+    assert "trace spans" in render_stats_table(summ)
+
+
+# ---------------------------------------------------------------------
+# 5. env-driven device captures (LIGHTGBM_TPU_TRACE_TO / _XPROF)
+# ---------------------------------------------------------------------
+
+class _FakeTracer:
+    """Records enter/exit pairs in place of jax.profiler captures."""
+
+    def __init__(self):
+        self.log = []
+
+    def __call__(self, log_dir):
+        tracer = self
+
+        class _CM:
+            def __enter__(self):
+                tracer.log.append(("enter", log_dir))
+                return self
+
+            def __exit__(self, *exc):
+                tracer.log.append(("exit", log_dir))
+                return False
+
+        return _CM()
+
+
+def test_parse_xprof_spec():
+    from lightgbm_tpu.utils.timer import parse_xprof_spec
+    assert parse_xprof_spec("/tmp/x:iters=3-7") == ("/tmp/x", 3, 7)
+    assert parse_xprof_spec("/tmp/x:iters=4") == ("/tmp/x", 4, 4)
+    # windows-ish dirs with colons survive the rsplit
+    assert parse_xprof_spec("a:b:iters=0-1") == ("a:b", 0, 1)
+    for bad in ("/tmp/x", "/tmp/x:iters=a-b", ":iters=1-2",
+                "/tmp/x:iters=5-2", "/tmp/x:iters=-1"):
+        with pytest.raises(ValueError):
+            parse_xprof_spec(bad)
+
+
+def test_env_capture_from_env():
+    from lightgbm_tpu.utils.timer import EnvCapture
+    assert EnvCapture.from_env({}) is None
+    cap = EnvCapture.from_env({"LIGHTGBM_TPU_TRACE_TO": "/tmp/t"})
+    assert cap._trace_dir == "/tmp/t" and cap._xprof is None
+    cap = EnvCapture.from_env(
+        {"LIGHTGBM_TPU_XPROF": "/tmp/x:iters=2-3"})
+    assert cap._xprof == ("/tmp/x", 2, 3)
+    with pytest.raises(ValueError):
+        EnvCapture.from_env({"LIGHTGBM_TPU_XPROF": "nope"})
+
+
+def test_env_capture_whole_run_and_window():
+    from lightgbm_tpu.utils.timer import EnvCapture
+    fake = _FakeTracer()
+    cap = EnvCapture(trace_dir="whole", xprof=("win", 2, 3),
+                     _tracer=fake)
+    cap.before_iteration(0)
+    assert fake.log == [("enter", "whole")]  # window not armed yet
+    cap.after_iteration(0)
+    cap.before_iteration(2)
+    assert ("enter", "win") in fake.log
+    cap.after_iteration(2)       # i < last: window stays open
+    assert ("exit", "win") not in fake.log
+    cap.before_iteration(3)
+    cap.after_iteration(3)       # i == last: window closes, disarms
+    assert fake.log.count(("exit", "win")) == 1
+    cap.before_iteration(4)      # never re-armed
+    assert fake.log.count(("enter", "win")) == 1
+    cap.close()
+    assert fake.log[-1] == ("exit", "whole")
+    cap.close()                  # idempotent
+    assert fake.log.count(("exit", "whole")) == 1
+
+
+def test_env_capture_close_finalizes_open_window():
+    from lightgbm_tpu.utils.timer import EnvCapture
+    fake = _FakeTracer()
+    cap = EnvCapture(xprof=("win", 0, 100), _tracer=fake)
+    cap.before_iteration(0)
+    cap.after_iteration(0)       # window still open (last=100)
+    cap.close()                  # exception-path finalization
+    assert fake.log == [("enter", "win"), ("exit", "win")]
+
+
+def test_timed_is_shared_noop_outside_any_capture():
+    from lightgbm_tpu.utils import timer as tm
+    assert not tm.Timer._enabled
+    assert tm.timed("anything") is tm._NULL
+
+
+@pytest.mark.slow
+def test_timed_annotates_only_while_capture_live(tmp_path):
+    """The TRACE_TO satellite: inside a live trace_to capture the
+    SAME timed() call switches from the shared no-op to the
+    TraceAnnotation-emitting path; after the capture it reverts."""
+    from lightgbm_tpu.utils import timer as tm
+    assert tm.timed("x") is tm._NULL
+    with tm.trace_to(str(tmp_path / "prof")):
+        cm = tm.timed("x")
+        assert cm is not tm._NULL
+        with cm:
+            pass
+    assert tm.timed("x") is tm._NULL
+    # the capture actually materialized profile artifacts
+    assert any((tmp_path / "prof").rglob("*"))
