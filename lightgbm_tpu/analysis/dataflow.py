@@ -21,6 +21,12 @@ other than the caller's? Seeds: ``threading.Thread(target=f)``,
 ``watchdog.guarded(name, fn, ...)`` (the collective watchdog runs it
 on a fresh daemon worker). Closed transitively over the call graph, so
 a helper called from a guarded collective body is thread-side too.
+Method calls on *constructor-typed* receivers are followed as well:
+when thread-side code calls ``obj.m(...)`` and ``obj`` was assigned
+from ``SomeClass(...)`` in the scope or its enclosing function chain
+(the supervisor's ``policy = AutoscalePolicy(...)`` consumed by the
+nested scrape loop), ``SomeClass.m`` joins the closure — the plain
+reference graph cannot see through a method call on a local.
 
 **float64 producers** (TPL009) — numpy expressions whose value is
 float64: explicit ``np.float64`` / ``dtype=np.float64`` /
@@ -300,6 +306,69 @@ def resolve_fn_arg(graph: CallGraph, scan: ModuleScan,
     return None
 
 
+def _ctor_class_of(scan: ModuleScan, qual: Optional[str],
+                   var: str) -> Optional[str]:
+    """The class name ``var`` was constructed from, when a
+    ``var = SomeClass(...)`` assignment is visible in the function
+    ``qual`` or its enclosing chain (closure variables: the
+    supervisor assigns ``policy = AutoscalePolicy(...)`` and the
+    nested scrape loop calls ``policy.observe(...)``). Only
+    ``Name(...)`` constructor calls count, and only names that look
+    like classes (leading capital or underscore-prefixed CapWords) —
+    a ``rows = load(...)`` assignment must not type ``rows``."""
+    while qual:
+        info = scan.funcs.get(qual)
+        if info is None:
+            return None
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) \
+                    and node.value is not None:
+                targets = [node.target]
+            else:
+                continue
+            val = node.value
+            if not (isinstance(val, ast.Call)
+                    and isinstance(val.func, ast.Name)):
+                continue
+            name = val.func.id
+            if not name.lstrip("_")[:1].isupper():
+                continue
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == var:
+                    return name
+        qual = info.parent_qual
+    return None
+
+
+def _method_call_targets(graph: CallGraph, key: Key,
+                         methods_by_qual: Dict[str, Set[Key]]
+                         ) -> Set[Key]:
+    """Class methods a scope invokes through ``obj.m(...)`` where
+    ``obj``'s class is recoverable via :func:`_ctor_class_of`.
+    Matching is by ``Class.method`` qualname across every scanned
+    module (the class is usually imported from a sibling module, so
+    the receiver's scan does not hold its def)."""
+    out: Set[Key] = set()
+    facts = graph.facts.get(key)
+    scan = graph.scans.get(key[0])
+    if facts is None or scan is None:
+        return out
+    for rec in facts.records:
+        if rec.kind != "method" or not rec.attr or rec.node is None:
+            continue
+        fn = rec.node.func
+        if not (isinstance(fn, ast.Attribute)
+                and isinstance(fn.value, ast.Name)):
+            continue
+        cls = _ctor_class_of(scan, key[1], fn.value.id)
+        if cls is None:
+            continue
+        out |= methods_by_qual.get(f"{cls}.{rec.attr}", set())
+    return out
+
+
 def thread_side_functions(graph: CallGraph) -> Dict[Key, Tuple[str, int]]:
     """Every function that runs on a spawned thread, mapped to
     ``(how, seed lineno)`` where ``how`` names the spawn site
@@ -359,16 +428,26 @@ def thread_side_functions(graph: CallGraph) -> Dict[Key, Tuple[str, int]]:
             key = resolve_fn_arg(graph, scan, rec.scope, fn_node)
             if key is not None:
                 seeds.setdefault(key, (how, rec.node.lineno))
-    # transitive closure over the reference graph
+    # transitive closure over the reference graph, plus
+    # constructor-typed method calls (refs cannot see through
+    # ``policy.observe(...)`` on a closure variable)
     out_edges: Dict[Optional[Key], Set[Key]] = {}
     for r in graph.refs:
         out_edges.setdefault(r.scope, set()).add(r.target)
+    methods_by_qual: Dict[str, Set[Key]] = {}
+    for scan in graph.scans.values():
+        for info in scan.funcs.values():
+            if info.class_name:
+                methods_by_qual.setdefault(
+                    info.key[1], set()).add(info.key)
     result = dict(seeds)
     frontier = list(seeds)
     while frontier:
         k = frontier.pop()
         how, ln = result[k]
-        for callee in out_edges.get(k, ()):
+        callees = set(out_edges.get(k, ()))
+        callees |= _method_call_targets(graph, k, methods_by_qual)
+        for callee in callees:
             if callee not in result:
                 result[callee] = (how, ln)
                 frontier.append(callee)

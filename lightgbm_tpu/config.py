@@ -521,6 +521,33 @@ class Config:
     # replies to reach the wire, and only then closes the socket — a
     # supervised restart never drops an accepted request
     serve_shutdown_grace_sec: float = 15.0
+    # replica autoscaling floor (resilience/autoscale.py,
+    # docs/RESILIENCE.md "Autoscaling policy"): the fleet supervisor
+    # never retires below this many replicas
+    serve_min_replicas: int = 1
+    # autoscaling ceiling: the fleet supervisor spawns replicas up to
+    # this count on load (fleet QPS / p99 / shed signals) and retires
+    # them — graceful drain, zero dropped in-flight requests — when
+    # the load subsides. 0 (default) disables autoscaling (fixed
+    # fleet)
+    serve_max_replicas: int = 0
+    # scale-up QPS threshold: scale up when the fleet-total QPS
+    # exceeds this per active replica (0 disables the QPS signal)
+    autoscale_up_qps: float = 0.0
+    # scale-down QPS threshold: scale down only when the fleet-total
+    # QPS would still stay under this per replica with one replica
+    # FEWER. Keep it strictly below autoscale_up_qps — that gap is
+    # the hysteresis band that stops the fleet flapping (0 disables
+    # scale-down)
+    autoscale_down_qps: float = 0.0
+    # scale-up latency threshold: scale up when any replica's p99
+    # exceeds this many milliseconds (0 disables the latency signal)
+    autoscale_up_p99_ms: float = 0.0
+    # cooldown seconds after ANY scaling action before the next
+    # scale-up / scale-down may fire (the other half of hysteresis:
+    # one load spike cannot double-scale between scrapes)
+    autoscale_up_cooldown_sec: float = 5.0
+    autoscale_down_cooldown_sec: float = 15.0
 
     # ---- observability (lightgbm_tpu/obs/; docs/OBSERVABILITY.md) ----
     # base port of the OpenMetrics /metrics HTTP endpoint
@@ -555,6 +582,27 @@ class Config:
     # retries (doubles per attempt, capped at 15 s, x[0.5, 1.5)
     # jitter — the init_distributed retry shape)
     publish_backoff_sec: float = 0.25
+    # retention: after a successful publish, prune publications
+    # beyond this many newest VALID manifests from the publish target
+    # (atomic through the store; the currently-served and
+    # last-known-good models are never pruned). 0 (default) keeps
+    # everything
+    publish_keep: int = 0
+    # canary validation batch (docs/SERVING.md "Canary gate"): rows
+    # embedded in each publication manifest together with the raw
+    # scores the publishing model produced for them; a serve replica
+    # scores them through its real compiled forest BEFORE swapping
+    # and refuses the publication on mismatch. 0 disables the gate
+    canary_rows: int = 8
+    # absolute tolerance for canary raw-score agreement between the
+    # publisher's booster and the replica's compiled forest
+    canary_tol: float = 1e-3
+    # publish transport target (resilience/store.py): "" (default)
+    # publishes into the pipeline's local publish/ directory; a
+    # "mem://<name>" spec (tests) or any ArtifactStore-shaped target
+    # rides the same manifest-first protocol without a shared
+    # filesystem
+    publish_store: str = ""
 
     # ---- convert ----
     convert_model_language: str = ""
@@ -734,8 +782,18 @@ class Config:
         "serve_shed_queue_rows": (0, None),
         "serve_shed_p99_ms": (0.0, None),
         "serve_shutdown_grace_sec": (0.0, None),
+        "serve_min_replicas": (1, None),
+        "serve_max_replicas": (0, None),
+        "autoscale_up_qps": (0.0, None),
+        "autoscale_down_qps": (0.0, None),
+        "autoscale_up_p99_ms": (0.0, None),
+        "autoscale_up_cooldown_sec": (0.0, None, "gt"),
+        "autoscale_down_cooldown_sec": (0.0, None, "gt"),
         "publish_retries": (0, None),
         "publish_backoff_sec": (0.0, None),
+        "publish_keep": (0, None),
+        "canary_rows": (0, None),
+        "canary_tol": (0.0, None, "gt"),
         "metrics_port": (0, 65535),
         "metrics_scrape_interval_sec": (0.0, None),
         "trace_sample_every": (0, None),
@@ -821,6 +879,20 @@ class Config:
                 "serve_min_bucket_rows must be <= serve_max_batch_rows "
                 f"({self.serve_min_bucket_rows} > "
                 f"{self.serve_max_batch_rows})")
+        if self.serve_max_replicas \
+                and self.serve_min_replicas > self.serve_max_replicas:
+            raise ValueError(
+                "serve_min_replicas must be <= serve_max_replicas "
+                f"({self.serve_min_replicas} > "
+                f"{self.serve_max_replicas})")
+        if self.autoscale_up_qps > 0 and self.autoscale_down_qps > 0 \
+                and self.autoscale_down_qps >= self.autoscale_up_qps:
+            raise ValueError(
+                "autoscale_down_qps must stay strictly below "
+                "autoscale_up_qps — that gap is the hysteresis band "
+                "that stops the fleet flapping "
+                f"({self.autoscale_down_qps} >= "
+                f"{self.autoscale_up_qps})")
         if self.serve_shed_queue_rows \
                 and self.serve_shed_queue_rows >= self.serve_queue_rows:
             raise ValueError(

@@ -454,6 +454,10 @@ def summarize_events(path: str) -> dict:
     compiles: Dict[str, Dict[str, object]] = {}
     fleet_events = 0
     fleet: Optional[Dict[str, object]] = None
+    autoscale: Dict[str, int] = {}
+    autoscale_last: Optional[Dict[str, object]] = None
+    rollbacks = 0
+    rollback_last: Optional[Dict[str, object]] = None
     spans = 0
 
     def _parse(line: str, is_last: bool) -> Optional[dict]:
@@ -520,6 +524,21 @@ def summarize_events(path: str) -> dict:
             fleet_events += 1
             fleet = {k: v for k, v in ev.items() if k != "event"}
             continue
+        if ev.get("event") == "autoscale":
+            # one line per scaling action (resilience/elastic.py):
+            # counted per direction, newest kept for provenance
+            action = str(ev.get("action", "?"))
+            autoscale[action] = autoscale.get(action, 0) + 1
+            autoscale_last = {k: v for k, v in ev.items()
+                              if k != "event"}
+            continue
+        if ev.get("event") == "rollback":
+            # one line per publication rollback ordered by the fleet
+            # supervisor's canary/health guard (docs/RESILIENCE.md)
+            rollbacks += 1
+            rollback_last = {k: v for k, v in ev.items()
+                             if k != "event"}
+            continue
         if ev.get("event") == "span":
             # trace spans are counted here and analyzed by
             # `lightgbm_tpu trace <dir>` (obs/trace.py)
@@ -576,6 +595,8 @@ def summarize_events(path: str) -> dict:
             "scan_iterations": scan_iterations,
             "compiles": compiles,
             "fleet": fleet, "fleet_events": fleet_events,
+            "autoscale": autoscale, "autoscale_last": autoscale_last,
+            "rollbacks": rollbacks, "rollback": rollback_last,
             "spans": spans}
 
 
@@ -693,6 +714,18 @@ def render_stats_table(summary: dict) -> str:
             f"fleet                : {alive}/{len(replicas)} "
             f"{flt.get('shape', 'replicas')} up in "
             f"{summary.get('fleet_events', 0)} scrape(s){extras}")
+    asc = summary.get("autoscale") or {}
+    if asc:
+        lines.append(
+            f"autoscale            : {asc.get('up', 0)} up / "
+            f"{asc.get('down', 0)} down")
+    if summary.get("rollbacks"):
+        rb = summary.get("rollback") or {}
+        bad = str(rb.get("bad_sha") or "?")[:12]
+        good = str(rb.get("good_sha") or "?")[:12]
+        lines.append(
+            f"rollbacks            : {summary['rollbacks']} "
+            f"(last: bad {bad} -> good {good})")
     if summary.get("scan_windows"):
         lines.append(
             f"fused scan           : {summary['scan_iterations']} "
@@ -772,6 +805,7 @@ def merge_fleet_summaries(entries: List[Tuple[str, dict]]) -> dict:
         "shed_total": 0, "swaps_total": 0,
         "qps": 0.0, "p99_ms_max": None,
         "restarts_total": 0, "iteration_skew": None,
+        "scale_ups": 0, "scale_downs": 0, "rollbacks": 0,
     }
     for _rel, s in entries:
         merged["iterations"] += int(s.get("iterations") or 0)
@@ -803,6 +837,10 @@ def merge_fleet_summaries(entries: List[Tuple[str, dict]]) -> dict:
                 merged["iteration_skew"] = max(
                     merged["iteration_skew"] or 0,
                     int(flt["iteration_skew"]))
+        asc = s.get("autoscale") or {}
+        merged["scale_ups"] += int(asc.get("up") or 0)
+        merged["scale_downs"] += int(asc.get("down") or 0)
+        merged["rollbacks"] += int(s.get("rollbacks") or 0)
     return merged
 
 
@@ -824,6 +862,12 @@ def render_fleet_table(merged: dict) -> str:
             f"worst p99 {'n/a' if p99 is None else '%g ms' % p99}, "
             f"shed {merged['shed_total']}, swaps "
             f"{merged['swaps_total']}")
+    if merged.get("scale_ups") or merged.get("scale_downs"):
+        lines.append(
+            f"autoscale            : {merged['scale_ups']} up / "
+            f"{merged['scale_downs']} down")
+    if merged.get("rollbacks"):
+        lines.append(f"rollbacks            : {merged['rollbacks']}")
     extras = []
     if merged["restarts_total"]:
         extras.append(f"restarts {merged['restarts_total']}")

@@ -27,6 +27,17 @@ One supervised lifecycle joins every subsystem the ROADMAP grew
   whole run and records client-side QPS / latency / shed / error
   continuity into the pipeline's JSONL telemetry — the proof that
   swaps, replica kills and torn publishes never broke the service.
+  ``--spike-rate`` turns it into the autoscaling chaos drill: a
+  timed load spike the fleet supervisor scales up into
+  (``--max-replicas``, resilience/autoscale.py) and back down out of
+  (graceful drain — retiring replicas answer ``{"error":
+  "draining"}`` and the generator fails over).
+- **Canary-gated rollout**: with ``--canary-rows`` each publication
+  embeds validation rows + expected raw scores; every replica scores
+  them through its real compiled forest BEFORE swapping, refuses a
+  mismatching (poisoned) publication, and the fleet supervisor rolls
+  the publication back to last-known-good — the pipeline counts that
+  as service success (``rollbacks`` in the summary), not a failure.
 
 This module's CLI dispatch, the supervisor loop and the load
 generator are jax-free (like ``lint`` / ``launch``): jax loads only
@@ -142,13 +153,22 @@ class LoadGenerator:
     One worker thread round-robins the replica ports, keeps one
     persistent connection per replica (reconnecting on failure), and
     classifies every outcome: ``ok``, ``shed`` (typed overload reply),
-    ``overloaded`` (hard backpressure), ``error`` (error reply),
-    ``conn`` (connect/reset — a killed replica), ``timeout`` (a reply
-    that never came: the one class that would mean a silently dropped
-    accepted request). Stats are read by the supervisor thread, so
-    every mutable field lives under ``self._lock``; all socket I/O
-    happens outside it (TPL006/TPL008).
+    ``overloaded`` (hard backpressure), ``draining`` (typed graceful-
+    shutdown refusal from a retiring replica — the client's cue to
+    fail over, never a dropped request), ``error`` (error reply),
+    ``conn`` (connect/reset — a killed or not-yet-scaled-up replica),
+    ``timeout`` (a reply that never came: the one class that would
+    mean a silently dropped accepted request). A port that failed to
+    connect is skipped for a short backoff so traffic concentrates on
+    live replicas (an autoscaled fleet has ports that are legitimately
+    down). The request rate is adjustable mid-run (``set_rate`` — the
+    pipeline's load-spike driver), so it lives under ``self._lock``
+    with the stats; all socket I/O happens outside it
+    (TPL006/TPL008).
     """
+
+    #: seconds a port sits out after a failed connect (worker-local)
+    DEAD_PORT_BACKOFF_SEC = 1.0
 
     def __init__(self, ports: List[int], n_features: int,
                  rate_per_sec: float = 20.0, rows_per_request: int = 4,
@@ -158,7 +178,6 @@ class LoadGenerator:
                  trace_every: int = 0):
         self.ports = list(ports)
         self.n_features = int(n_features)
-        self.rate = max(0.1, float(rate_per_sec))
         self.rows = max(1, int(rows_per_request))
         self.reply_timeout = float(reply_timeout)
         self.event_log = event_log
@@ -171,9 +190,10 @@ class LoadGenerator:
         self._stop = threading.Event()
         self._lock = threading.Lock()
         # ---- guarded by self._lock ----
+        self._rate = max(0.1, float(rate_per_sec))
         self._counts = {"attempts": 0, "ok": 0, "shed": 0,
-                        "overloaded": 0, "error": 0, "conn": 0,
-                        "timeout": 0}
+                        "overloaded": 0, "draining": 0, "error": 0,
+                        "conn": 0, "timeout": 0}
         self._latencies: deque = deque(maxlen=4096)
         self._last_ok: Optional[float] = None
         self._max_ok_gap = 0.0
@@ -188,6 +208,16 @@ class LoadGenerator:
     def stop(self, timeout: float = 30.0) -> None:
         self._stop.set()
         self._thread.join(timeout=timeout)
+
+    def set_rate(self, rate_per_sec: float) -> None:
+        """Retarget the request rate mid-run (the load-spike driver);
+        the worker picks it up on its next period."""
+        with self._lock:
+            self._rate = max(0.1, float(rate_per_sec))
+
+    def rate(self) -> float:
+        with self._lock:
+            return self._rate
 
     def _note(self, outcome: str, latency: Optional[float] = None,
               model: Optional[str] = None,
@@ -250,12 +280,28 @@ class LoadGenerator:
         import random as _random
         rng = _random.Random(1234)
         conns: Dict[int, Any] = {}
-        period = 1.0 / self.rate
+        # worker-local failover state: a port that refused a connect
+        # sits out a short backoff so traffic concentrates on live
+        # replicas (only this thread reads/writes it — no lock)
+        dead_until: Dict[int, float] = {}
         next_stats = time.monotonic() + self.stats_interval
         i = 0
-        while not self._stop.wait(period):
-            port = self.ports[i % len(self.ports)]
-            i += 1
+        while True:
+            with self._lock:
+                period = 1.0 / self._rate
+            if self._stop.wait(period):
+                break
+            now = time.monotonic()
+            port = None
+            for _ in range(len(self.ports)):
+                candidate = self.ports[i % len(self.ports)]
+                i += 1
+                if dead_until.get(candidate, 0.0) <= now:
+                    port = candidate
+                    break
+            if port is None:                 # every port backing off:
+                port = self.ports[i % len(self.ports)]
+                i += 1                       # probe one anyway
             rows = [[rng.uniform(-2.0, 2.0)
                      for _ in range(self.n_features)]
                     for _ in range(self.rows)]
@@ -290,10 +336,19 @@ class LoadGenerator:
                 stats = self._note("timeout", want_stats=want)
             except (OSError, ValueError):
                 conns.pop(port, None)
+                dead_until[port] = (time.monotonic()
+                                    + self.DEAD_PORT_BACKOFF_SEC)
                 stats = self._note("conn", want_stats=want)
             else:
                 dt = time.monotonic() - t0
-                if reply.get("shed"):
+                if reply.get("draining"):
+                    # typed graceful-shutdown refusal: fail over now —
+                    # the replica is retiring and will close
+                    conns.pop(port, None)
+                    dead_until[port] = (time.monotonic()
+                                        + self.DEAD_PORT_BACKOFF_SEC)
+                    stats = self._note("draining", want_stats=want)
+                elif reply.get("shed"):
                     stats = self._note("shed", want_stats=want)
                 elif reply.get("overloaded"):
                     stats = self._note("overloaded", want_stats=want)
@@ -327,6 +382,25 @@ class LoadGenerator:
                 pass
 
 
+def _drive_spike(loadgen: "LoadGenerator", events: _EventLog,
+                 base_rate: float, spike_rate: float,
+                 start_sec: float, duration_sec: float,
+                 stop: threading.Event) -> None:
+    """One load spike: wait, jump the request rate, hold, fall back —
+    the traffic shape the autoscaling chaos drill scales up into and
+    back down out of. Runs on its own daemon thread; the stop event
+    aborts the wait phases but the rate is ALWAYS restored."""
+    if stop.wait(max(0.0, float(start_sec))):
+        return
+    loadgen.set_rate(spike_rate)
+    events.write({"event": "pipeline", "phase": "spike_start",
+                  "rate": float(spike_rate), "time": time.time()})
+    stop.wait(max(0.0, float(duration_sec)))
+    loadgen.set_rate(base_rate)
+    events.write({"event": "pipeline", "phase": "spike_end",
+                  "rate": float(base_rate), "time": time.time()})
+
+
 class _ClientMetrics:
     """Bridges the load generator's client-side view into the
     supervisor's /metrics endpoint (obs/export.py extra families).
@@ -354,8 +428,8 @@ class _ClientMetrics:
         from .obs.export import counter_family, gauge_family
         snap = loadgen.snapshot()
         fams: Dict[str, Any] = {}
-        for key in ("attempts", "ok", "shed", "overloaded", "error",
-                    "conn", "timeout"):
+        for key in ("attempts", "ok", "shed", "overloaded",
+                    "draining", "error", "conn", "timeout"):
             fams[f"client_{key}"] = counter_family(snap.get(key, 0))
         for key in ("p50_ms", "p99_ms", "max_ok_gap_s",
                     "since_last_ok_s"):
@@ -374,10 +448,13 @@ under supervision (docs/PIPELINE.md): training generations run under
 the elastic supervisor with per-generation checkpoint auto-resume,
 models publish atomically (manifest-first, sha256-validated, retried
 with backoff) into the serve fleet's watch directory, and the fleet
-runs under `launch --health-port` with per-replica restarts. Chaos
-rides LIGHTGBM_TPU_FAULT_INJECT / --fault-inject: serve_kill@N goes to
-the fleet, everything else (rank_kill@I, publish_torn@G, refit_nan@T,
-nan_grad@I, ...) to the training workers.
+runs under `launch --health-port` with per-replica restarts, replica
+autoscaling (--max-replicas) and canary-gated rollout with automatic
+rollback (--canary-rows / --rollback-grace). Chaos rides
+LIGHTGBM_TPU_FAULT_INJECT / --fault-inject: serve_kill@N goes to the
+fleet, everything else (rank_kill@I, publish_torn@G, store_outage@G,
+publish_poison@G, refit_nan@T, nan_grad@I, ...) to the training
+workers.
 
 exit codes:
   0  every generation trained, published, and was confirmed serving
@@ -439,6 +516,62 @@ def build_parser() -> argparse.ArgumentParser:
                         "disables the load generator)")
     p.add_argument("--request-rows", type=int, default=4,
                    help="rows per generated request")
+    p.add_argument("--spike-rate", type=float, default=0.0,
+                   help="load-spike request rate: after --spike-start "
+                        "seconds the load generator jumps to this "
+                        "rate for --spike-duration seconds, then "
+                        "falls back (0 = no spike; the autoscaling "
+                        "chaos drill)")
+    p.add_argument("--spike-start", type=float, default=5.0,
+                   help="seconds after the fleet is ready before the "
+                        "load spike begins")
+    p.add_argument("--spike-duration", type=float, default=10.0,
+                   help="seconds the load spike lasts")
+    p.add_argument("--max-replicas", type=int,
+                   default=Config.serve_max_replicas,
+                   help="replica autoscaling ceiling: the fleet "
+                        "supervisor spawns replicas up to this count "
+                        "on load and retires them (graceful drain) "
+                        "when it subsides (0 = fixed fleet)")
+    p.add_argument("--min-replicas", type=int, default=0,
+                   help="autoscaling floor (default: --replicas)")
+    p.add_argument("--autoscale-up-qps", type=float,
+                   default=Config.autoscale_up_qps,
+                   help="scale up when fleet QPS exceeds this per "
+                        "active replica")
+    p.add_argument("--autoscale-down-qps", type=float,
+                   default=Config.autoscale_down_qps,
+                   help="scale down when fleet QPS would stay under "
+                        "this per replica with one replica fewer "
+                        "(hysteresis: keep it below --autoscale-up-"
+                        "qps)")
+    p.add_argument("--autoscale-up-p99-ms", type=float,
+                   default=Config.autoscale_up_p99_ms,
+                   help="scale up when any replica's p99 exceeds "
+                        "this (0 = QPS/shed signals only)")
+    p.add_argument("--retire-grace", type=float, default=10.0,
+                   help="seconds a scaled-down replica gets to drain "
+                        "in-flight requests before a hard kill")
+    p.add_argument("--rollback-grace", type=float, default=6.0,
+                   help="seconds the fleet supervisor waits for some "
+                        "replica to adopt a new publication before a "
+                        "canary-refused one is rolled back")
+    p.add_argument("--publish-keep", type=int,
+                   default=Config.publish_keep,
+                   help="retention: prune publications beyond the N "
+                        "newest valid manifests after each publish "
+                        "(0 = keep everything; the served and last-"
+                        "known-good models are never pruned)")
+    p.add_argument("--canary-rows", type=int,
+                   default=Config.canary_rows,
+                   help="validation rows embedded in each publication "
+                        "manifest; replicas score them through the "
+                        "real compiled forest BEFORE swapping and "
+                        "refuse on mismatch (0 = no canary gate)")
+    p.add_argument("--canary-tol", type=float,
+                   default=Config.canary_tol,
+                   help="absolute tolerance for canary raw-score "
+                        "agreement")
     p.add_argument("--trace-every", type=int,
                    default=Config.trace_sample_every,
                    help="originate a distributed trace on every Nth "
@@ -603,8 +736,32 @@ def _train_worker(args) -> int:
     train_auc = _auc(y, bst.predict(X))
     digest = getattr(ds, "_data_digest", None)
     cfg = Config.from_params(params)
+    canary = None
+    if int(args.canary_rows) > 0:
+        # the serve-side validation batch (docs/SERVING.md): rows are
+        # rounded to float32 first — the daemon feeds float32 to the
+        # compiled forest, and tree thresholds must see the SAME
+        # values here, or a split on the rounding gap would flip a
+        # leaf and fail a perfectly good canary
+        c_rng = np.random.RandomState(args.seed * 1000 + gen + 777)
+        c_rows = c_rng.uniform(
+            -2.0, 2.0,
+            size=(int(args.canary_rows), int(args.features))
+        ).astype(np.float32)
+        c_scores = np.asarray(
+            bst.predict(c_rows.astype(np.float64), raw_score=True),
+            np.float64).reshape(-1)
+        canary = {"rows": [[float(v) for v in row] for row in c_rows],
+                  "scores": [float(s) for s in c_scores],
+                  "tol": float(args.canary_tol)}
+    # retention never prunes what the fleet still depends on: the
+    # warm-start source (the currently-served / rollback target)
+    protect = (prev[1]["sha256"],) \
+        if prev is not None and prev[1].get("sha256") else ()
     manifest = publish_model(
         bst, publish_dir, f"model_g{gen:04d}.txt",
+        canary=canary, keep=int(args.publish_keep),
+        protect_shas=protect,
         metadata={
             "generation": gen,
             "train_auc": round(train_auc, 6),
@@ -632,8 +789,19 @@ def _train_worker(args) -> int:
         except Exception:
             spans = []
         try:
+            # fault events taken during publish (store_outage /
+            # publish_torn / publish_poison retries) land on the
+            # process-level log after the recorder closed — drain them
+            # here or the post-mortem loses the retry evidence
+            from .resilience.faults import FAULT_EVENTS, drain_events
+            faults = drain_events(FAULT_EVENTS)
+        except Exception:
+            faults = []
+        try:
             with open(telem, "a", encoding="utf-8") as fh:
                 for ev in spans:
+                    fh.write(json.dumps(ev) + "\n")
+                for ev in faults:
                     fh.write(json.dumps(ev) + "\n")
                 fh.write(json.dumps(
                     {"event": "publish", **manifest}) + "\n")
@@ -660,6 +828,9 @@ def _worker_cmd(args, gen: int) -> List[str]:
            "--warm-start", args.warm_start,
            "--refit-decay", str(args.refit_decay),
            "--ingest-chunk-rows", str(args.ingest_chunk_rows),
+           "--publish-keep", str(args.publish_keep),
+           "--canary-rows", str(args.canary_rows),
+           "--canary-tol", str(args.canary_tol),
            "--seed", str(args.seed)]
     for pair in args.param:
         cmd += ["--param", pair]
@@ -740,6 +911,11 @@ def _start_fleet(args, dirs: Dict[str, str], base_port: int,
            "--health-interval", str(args.health_interval),
            "--health-grace", str(args.health_grace),
            "--grace", str(args.grace),
+           # rollback guard: the fleet supervisor watches the publish
+           # target, adopts publications the fleet serves and rolls a
+           # canary-refused one back to last-known-good
+           "--publish-dir", dirs["publish"],
+           "--rollback-grace", str(args.rollback_grace),
            # fleet scrape cadence: per-replica QPS/p99/shed/restarts
            # into telemetry/serve.jsonl.fleet (docs/OBSERVABILITY.md)
            "--scrape-interval", str(args.scrape_interval),
@@ -753,6 +929,17 @@ def _start_fleet(args, dirs: Dict[str, str], base_port: int,
            "--shed-queue-rows", str(args.shed_queue_rows),
            "--shed-p99-ms", str(args.shed_p99_ms),
            "--grace", str(args.grace)]
+    if args.max_replicas > 0:
+        # replica autoscaling (resilience/autoscale.py): the fleet
+        # supervisor spawns/retires replicas from the scrape signal
+        idx = cmd.index("--log-dir")
+        cmd[idx:idx] = [
+            "--min-replicas", str(args.min_replicas or args.replicas),
+            "--max-replicas", str(args.max_replicas),
+            "--autoscale-up-qps", str(args.autoscale_up_qps),
+            "--autoscale-down-qps", str(args.autoscale_down_qps),
+            "--autoscale-up-p99-ms", str(args.autoscale_up_p99_ms),
+            "--retire-grace", str(args.retire_grace)]
     if args.metrics_port:
         # fleet supervisor /metrics at base+2; it exports base+3 so
         # serve replica r binds base+3+r (the daemon adds its rank)
@@ -800,6 +987,65 @@ def _confirm_swap(ports: List[int], want_sha: str,
     return not pending
 
 
+def _read_fleet_events(path: str) -> List[Dict[str, Any]]:
+    """Every parseable JSONL event in the fleet supervisor's stream
+    (``telemetry/serve.jsonl.fleet``); [] when absent — the reader
+    side of the autoscale / rollback confirmation."""
+    events: List[Dict[str, Any]] = []
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(ev, dict):
+                    events.append(ev)
+    except OSError:
+        pass
+    return events
+
+
+def _await_rollback(fleet_stream: str, bad_sha: str,
+                    timeout: float) -> Optional[Dict[str, Any]]:
+    """The fleet supervisor's ``{"event": "rollback"}`` record for
+    ``bad_sha``, polling up to ``timeout`` seconds; None when the
+    fleet never rolled that publication back."""
+    deadline = time.monotonic() + timeout
+    while True:
+        for ev in _read_fleet_events(fleet_stream):
+            if ev.get("event") == "rollback" \
+                    and ev.get("bad_sha") == bad_sha:
+                return ev
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(0.5)
+
+
+def _fleet_lifecycle_summary(fleet_stream: str) -> Dict[str, Any]:
+    """Autoscale / rollback / replica-peak roll-up from the fleet
+    stream — the summary's proof that scaling and rollback actually
+    happened (or didn't)."""
+    ups = downs = rollbacks = 0
+    peak = 0
+    for ev in _read_fleet_events(fleet_stream):
+        kind = ev.get("event")
+        if kind == "autoscale":
+            if ev.get("action") == "up":
+                ups += 1
+            elif ev.get("action") == "down":
+                downs += 1
+            peak = max(peak, int(ev.get("replicas") or 0))
+        elif kind == "rollback":
+            rollbacks += 1
+        elif kind == "fleet":
+            alive = sum(1 for r in (ev.get("replicas") or [])
+                        if r.get("alive"))
+            peak = max(peak, alive)
+    return {"scale_ups": ups, "scale_downs": downs,
+            "rollbacks": rollbacks, "replicas_peak": peak}
+
+
 def _shutdown_fleet(fleet: subprocess.Popen, ports: List[int],
                     grace: float) -> None:
     """Graceful: ask every replica to drain and exit 0, so the fleet
@@ -842,7 +1088,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .resilience.elastic import _free_port
     from .resilience.publisher import latest_manifest
     base_port = args.port or _free_port()
-    ports = [base_port + r for r in range(args.replicas)]
+    if args.max_replicas > 0:
+        # autoscaling fleet: swap confirmation polls only the
+        # always-active floor (the autoscaler retires from the top
+        # rank down, so ranks below the floor never disappear); the
+        # load generator targets the whole potential range and backs
+        # off ports that are legitimately down
+        floor = min(args.replicas, args.min_replicas or args.replicas)
+        span = max(args.replicas, args.max_replicas)
+    else:
+        floor = span = args.replicas
+    ports = [base_port + r for r in range(max(1, floor))]
+    ready_ports = [base_port + r for r in range(args.replicas)]
+    all_ports = [base_port + r for r in range(span)]
+    fleet_stream = os.path.join(dirs["telemetry"],
+                                "serve.jsonl.fleet")
     events = _EventLog(os.path.join(dirs["telemetry"],
                                     "pipeline.jsonl"))
     client_metrics = _ClientMetrics()
@@ -854,12 +1114,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                               extra_families=client_metrics.families)
     events.write({"event": "pipeline", "phase": "start",
                   "generations": args.generations,
-                  "replicas": args.replicas, "ports": ports,
+                  "replicas": args.replicas, "ports": all_ports,
+                  "max_replicas": args.max_replicas,
                   "warm_start": args.warm_start,
                   "fault_inject": fault_spec, "time": time.time()})
     fleet: Optional[subprocess.Popen] = None
     loadgen: Optional[LoadGenerator] = None
+    spike_stop = threading.Event()
     failures: List[str] = []
+    rollbacks: List[Dict[str, Any]] = []
     swaps_confirmed = 0
     published: List[Dict[str, Any]] = []
     try:
@@ -868,28 +1131,41 @@ def main(argv: Optional[List[str]] = None) -> int:
         if rc != 0:
             failures.append(f"generation 0 training failed (exit {rc})")
             return _finish(args, events, failures, published,
-                           swaps_confirmed, None, loadgen)
+                           swaps_confirmed, None, loadgen,
+                           rollbacks, fleet_stream)
         first = latest_manifest(dirs["publish"])
         if first is None:
             failures.append("generation 0 published nothing usable")
             return _finish(args, events, failures, published,
-                           swaps_confirmed, None, loadgen)
+                           swaps_confirmed, None, loadgen,
+                           rollbacks, fleet_stream)
         published.append(first[1])
         fleet = _start_fleet(args, dirs, base_port, serve_faults)
-        if not _wait_fleet_ready(ports, timeout=args.swap_timeout):
-            failures.append(
-                f"serve fleet never became ready on ports {ports}")
+        if not _wait_fleet_ready(ready_ports,
+                                 timeout=args.swap_timeout):
+            failures.append(f"serve fleet never became ready on "
+                            f"ports {ready_ports}")
             return _finish(args, events, failures, published,
-                           swaps_confirmed, None, loadgen)
+                           swaps_confirmed, None, loadgen,
+                           rollbacks, fleet_stream)
         events.write({"event": "pipeline", "phase": "fleet_ready",
-                      "ports": ports, "time": time.time()})
+                      "ports": ready_ports, "time": time.time()})
         if args.request_rate > 0:
             loadgen = LoadGenerator(
-                ports, args.features, rate_per_sec=args.request_rate,
+                all_ports, args.features,
+                rate_per_sec=args.request_rate,
                 rows_per_request=args.request_rows,
                 event_log=events, trace_every=args.trace_every)
             loadgen.start()
             client_metrics.attach(loadgen)
+            if args.spike_rate > 0:
+                threading.Thread(
+                    target=_drive_spike,
+                    args=(loadgen, events, args.request_rate,
+                          args.spike_rate, args.spike_start,
+                          args.spike_duration, spike_stop),
+                    daemon=True,
+                    name="lightgbm-tpu-pipeline-spike").start()
         # the bootstrap model was loaded at startup, not hot-swapped:
         # confirm the fleet serves it before retraining begins
         if not _confirm_swap(ports, first[1]["sha256"],
@@ -924,34 +1200,67 @@ def main(argv: Optional[List[str]] = None) -> int:
                               "generation": gen,
                               "sha256": latest[1]["sha256"],
                               "time": time.time()})
-            else:
-                failures.append(
-                    f"fleet never confirmed generation {gen}'s "
-                    "publication within the swap timeout")
-                break
+                continue
+            # the fleet refused the swap: a canary-gated rollback by
+            # the fleet supervisor is SUCCESS for the service (the
+            # fleet kept last-known-good and superseded the bad
+            # publication), not a pipeline failure
+            rb = _await_rollback(fleet_stream, latest[1]["sha256"],
+                                 timeout=min(60.0, args.swap_timeout))
+            if rb is not None:
+                rollbacks.append(
+                    {"generation": gen,
+                     "bad_sha": rb.get("bad_sha"),
+                     "good_sha": rb.get("good_sha"),
+                     "good_file": rb.get("good_file")})
+                events.write({"event": "pipeline",
+                              "phase": "rollback_confirmed",
+                              "generation": gen, **{
+                                  k: rb.get(k)
+                                  for k in ("bad_sha", "good_sha",
+                                            "good_file")},
+                              "time": time.time()})
+                good_sha = rb.get("good_sha")
+                if good_sha and not _confirm_swap(
+                        ports, good_sha, timeout=args.swap_timeout):
+                    failures.append(
+                        f"fleet rolled generation {gen} back but "
+                        "never converged on the last-known-good "
+                        f"model {good_sha[:12]}")
+                    break
+                continue
+            failures.append(
+                f"fleet never confirmed generation {gen}'s "
+                "publication within the swap timeout")
+            break
         return _finish(args, events, failures, published,
-                       swaps_confirmed, ports, loadgen)
+                       swaps_confirmed, ports, loadgen,
+                       rollbacks, fleet_stream)
     finally:
+        spike_stop.set()
         if loadgen is not None:
             loadgen.stop()
         if fleet is not None and not args.keep_fleet:
-            _shutdown_fleet(fleet, ports, args.grace)
+            _shutdown_fleet(fleet, all_ports, args.grace)
         elif fleet is not None:
-            log_info(f"pipeline: fleet left running on ports {ports} "
-                     "(--keep-fleet)")
+            log_info(f"pipeline: fleet left running on ports "
+                     f"{all_ports} (--keep-fleet)")
         events.close()
 
 
 def _finish(args, events: _EventLog, failures: List[str],
             published: List[Dict[str, Any]], swaps_confirmed: int,
             ports: Optional[List[int]],
-            loadgen: Optional[LoadGenerator]) -> int:
+            loadgen: Optional[LoadGenerator],
+            rollbacks: Optional[List[Dict[str, Any]]] = None,
+            fleet_stream: Optional[str] = None) -> int:
     client = None if loadgen is None else loadgen.snapshot()
     summary: Dict[str, Any] = {
         "event": "pipeline_summary",
         "generations_requested": args.generations,
         "generations_published": len(published),
         "swaps_confirmed": swaps_confirmed,
+        "rollbacks": rollbacks or [],
         "last_published_sha256":
             published[-1]["sha256"] if published else None,
         "last_published_generation":
@@ -974,6 +1283,9 @@ def _finish(args, events: _EventLog, failures: List[str],
              "swap_failures": st.get("swap_failures"),
              "swaps_total": st.get("swaps_total")}
             for st in fleet_stats]
+    if fleet_stream is not None:
+        summary["fleet_lifecycle"] = \
+            _fleet_lifecycle_summary(fleet_stream)
     summary["client"] = client
     events.write(summary)
     print(json.dumps(summary), flush=True)

@@ -34,7 +34,16 @@ Two supervision shapes share this module:
   daemon's own JSON ``{"cmd": "ping"}`` protocol on
   ``health_port + rank`` — a replica that is alive-but-wedged (no
   exit code will ever come) fails ``--health-fails`` consecutive
-  pings and is killed and relaunched like a dead one.
+  pings and is killed and relaunched like a dead one. With
+  ``--max-replicas`` the fleet additionally GROWS and SHRINKS: an
+  :class:`~.autoscale.AutoscalePolicy` fed by the scrape thread
+  spawns fresh replicas under load (QPS / p99 / shed triggers with
+  hysteresis) and retires the highest-rank replica with a SIGTERM
+  drain when traffic subsides, and with ``--publish-dir`` a
+  :class:`~.autoscale.RollbackGuard` watches the newest publication
+  and rolls the store back to last-known-good when the fleet's
+  canary gates refuse it or a swapped replica trips post-swap health
+  checks (docs/RESILIENCE.md).
 
 Both shapes draw restarts from one :class:`RestartBudget`: a total
 cap (``--max-restarts``) plus an optional SLIDING WINDOW cap
@@ -67,6 +76,7 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional, Sequence
@@ -196,11 +206,15 @@ def replica_ping(port: int, timeout: float = 5.0,
 
 class _FleetTelemetry:
     """Append-only JSONL writer for the supervisor's ``{"event":
-    "fleet"}`` scrape records. Only the supervision loop writes (one
-    thread), so no lock; an unwritable path degrades to registry-only
-    scraping, mirroring the recorder's contract."""
+    "fleet"}`` scrape records. The fleet supervisor's SCRAPE thread
+    and its main supervision loop (autoscale / rollback events) both
+    write, so the file handle sits under a lock — interleaved partial
+    lines would corrupt the stream. An unwritable path degrades to
+    registry-only scraping, mirroring the recorder's contract."""
 
     def __init__(self, path: Optional[str]):
+        self._lock = threading.Lock()
+        # ---- guarded by self._lock ----
         self._file = None
         if not path:
             return
@@ -214,25 +228,28 @@ class _FleetTelemetry:
                         "written")
 
     def write(self, event: Dict) -> None:
-        if self._file is None:
-            return
-        try:
-            self._file.write(json.dumps(event) + "\n")
-            self._file.flush()
-        except OSError:
+        line = json.dumps(event) + "\n"
+        with self._lock:
+            if self._file is None:
+                return
             try:
-                self._file.close()
+                self._file.write(line)
+                self._file.flush()
             except OSError:
-                pass
-            self._file = None
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
 
     def close(self) -> None:
-        if self._file is not None:
-            try:
-                self._file.close()
-            except OSError:
-                pass
-            self._file = None
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None
 
 
 def _drain_spans_into(telem: "_FleetTelemetry") -> None:
@@ -273,6 +290,7 @@ _REPLICA_SAMPLES = (
     ("rows_total", "lightgbm_tpu_serve_rows_total"),
     ("shed_total", "lightgbm_tpu_serve_shed_total"),
     ("swaps_total", "lightgbm_tpu_serve_swaps_total"),
+    ("swap_failures_total", "lightgbm_tpu_serve_swap_failures_total"),
 )
 
 
@@ -300,6 +318,8 @@ def _replica_metrics_row(port: int, timeout: float) -> Dict:
         labels = dict(next(iter(info.keys())))
         if labels.get("model"):
             row["model"] = labels["model"]
+        if labels.get("sha"):
+            row["sha256"] = labels["sha"]
     return row
 
 
@@ -309,24 +329,57 @@ def _scrape_fleet(fleet: List["_Replica"], health_port: Optional[int],
     generation from the supervisor's own bookkeeping, QPS/p99/shed
     from each live replica's ``{"cmd": "metrics"}`` protocol verb.
     Feeds the supervisor's registry (its /metrics endpoint) and
-    returns the ``{"event": "fleet"}`` record."""
+    returns the ``{"event": "fleet"}`` record.
+
+    Per-replica fetches run CONCURRENTLY: a wedged replica — one that
+    accepts TCP but never replies — costs one ``health_timeout`` in
+    its own fetch thread, not one per healthy replica queued behind it
+    in a serial round. A replica whose process is up but whose metrics
+    fetch failed or timed out is marked ``alive: false`` (with
+    ``responsive: false``): "alive" in a fleet record means SERVING,
+    and a silent socket is not serving."""
     from ..obs.registry import registry
-    replicas = []
-    restarts_total = 0
+    live: List = []
+    results: Dict[int, Dict] = {}
+    results_lock = threading.Lock()
+    fetchers: List[threading.Thread] = []
     for rep in fleet:
         alive = (not rep.done and rep.relaunch_at is None
                  and rep.proc is not None and rep.proc.poll() is None)
+        live.append((rep, alive))
+        if alive and health_port is not None:
+            def _fetch(rank: int = rep.rank) -> None:
+                row = _replica_metrics_row(health_port + rank,
+                                           health_timeout)
+                with results_lock:
+                    results[rank] = row
+            t = threading.Thread(target=_fetch, daemon=True)
+            t.start()
+            fetchers.append(t)
+    deadline = time.monotonic() + health_timeout + 1.0
+    for t in fetchers:
+        t.join(timeout=max(0.1, deadline - time.monotonic()))
+    replicas = []
+    restarts_total = 0
+    for rep, alive in live:
         row: Dict = {"rank": rep.rank, "alive": alive,
                      "restarts": rep.generation}
+        if rep.retiring:
+            row["retiring"] = True
         restarts_total += rep.generation
         if alive and health_port is not None:
-            row.update(_replica_metrics_row(health_port + rep.rank,
-                                            health_timeout))
+            with results_lock:
+                metrics = results.get(rep.rank)
+            if metrics:
+                row.update(metrics)
+            else:
+                row["alive"] = False
+                row["responsive"] = False
         replicas.append(row)
         try:
             labels = {"rank": rep.rank}
             registry.gauge("fleet_replica_up", **labels).set(
-                1.0 if alive else 0.0)
+                1.0 if row["alive"] else 0.0)
             registry.gauge("fleet_replica_restarts", **labels).set(
                 rep.generation)
             for key, fam in (("qps", "fleet_replica_qps"),
@@ -631,12 +684,27 @@ def supervise(nprocs: int, cmd: Sequence[str], max_restarts: int = 3,
         _drain_spans_into(telem)
 
 
+def _term_group(proc: subprocess.Popen) -> None:
+    """SIGTERM a replica's whole process group — the graceful-drain
+    signal the serve daemon turns into stop-accepting + answer
+    backlogged connections with a draining reply + finish in-flight
+    work; fall back to terminating the process alone."""
+    try:
+        os.killpg(os.getpgid(proc.pid), signal.SIGTERM)
+    except (ProcessLookupError, PermissionError, OSError):
+        try:
+            proc.terminate()
+        except OSError:
+            pass
+
+
 class _Replica:
     """One independently-supervised fleet member."""
 
     __slots__ = ("rank", "proc", "generation", "launched_at",
                  "consecutive_restarts", "ping_failures", "done",
-                 "relaunch_at", "restart_t0")
+                 "relaunch_at", "restart_t0", "retiring",
+                 "retire_deadline")
 
     def __init__(self, rank: int):
         self.rank = rank
@@ -653,6 +721,11 @@ class _Replica:
         # perf_counter when the death/wedge was observed; closes into
         # a restart/replica span (obs/trace.py) at relaunch
         self.restart_t0: Optional[float] = None
+        # scale-down drain in progress: SIGTERM sent, any exit code
+        # finishes the replica WITHOUT a restart; past the deadline a
+        # drain that never ends is killed (a wedge, not a drain)
+        self.retiring = False
+        self.retire_deadline = 0.0
 
 
 def _launch_replica(rep: _Replica, cmd: Sequence[str], nprocs: int,
@@ -685,7 +758,17 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
                     health_grace: float = 60.0,
                     health_timeout: float = 5.0,
                     metrics_port: Optional[int] = None,
-                    scrape_interval: float = 0.0) -> int:
+                    scrape_interval: float = 0.0,
+                    min_replicas: Optional[int] = None,
+                    max_replicas: Optional[int] = None,
+                    autoscale_up_qps: float = 0.0,
+                    autoscale_down_qps: float = 0.0,
+                    autoscale_up_p99_ms: float = 0.0,
+                    autoscale_up_cooldown_sec: float = 5.0,
+                    autoscale_down_cooldown_sec: float = 15.0,
+                    retire_grace_sec: float = 30.0,
+                    publish_dir=None,
+                    rollback_grace_sec: float = 6.0) -> int:
     """Supervise ``nprocs`` INDEPENDENT replicas (the serving shape):
     a dead or health-check-failing replica is relaunched alone, on a
     per-replica jittered backoff, while the rest keep serving.
@@ -696,6 +779,26 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
     compile) has passed; ``health_fails`` consecutive failures mean
     alive-but-wedged, and the replica is killed and relaunched. None
     disables pinging (exit-code supervision only).
+
+    With ``max_replicas`` set (plus ``scrape_interval`` and the QPS /
+    p99 thresholds) the fleet AUTOSCALES: the scrape thread feeds an
+    :class:`~.autoscale.AutoscalePolicy` and the supervision loop
+    spawns fresh replicas on its "up" decisions (``nprocs`` is the
+    starting size; ``min_replicas`` defaults to it) and retires the
+    highest-rank replica on "down" — a SIGTERM drain, so a scaled-down
+    replica answers its in-flight and backlogged requests before
+    exiting (``retire_grace_sec`` caps a drain that never ends).
+    Scale-ups do not draw from the restart budget, but a fleet whose
+    budget is spent (a crash loop) refuses to grow.
+
+    With ``publish_dir`` also set, a :class:`~.autoscale.RollbackGuard`
+    watches the newest publication in that store: one that no replica
+    adopts while swap failures mount (every canary gate refused it),
+    or whose adopter is evicted by post-swap health checks, is rolled
+    back to the last-known-good manifest via
+    :func:`~.publisher.rollback_publication`
+    (``rollback_grace_sec`` is how long the fleet gets to adopt it
+    first).
 
     Returns 0 once every replica has exited cleanly (a graceful
     ``shutdown``), or the last failing exit code when the restart
@@ -709,39 +812,191 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
     os.makedirs(log_dir, exist_ok=True)
     budget = RestartBudget(max_restarts, max_restarts_per_window,
                            restart_window_sec)
+    policy = None
+    if max_replicas and int(max_replicas) > 0 \
+            and health_port is not None and scrape_interval > 0 \
+            and (autoscale_up_qps > 0 or autoscale_up_p99_ms > 0):
+        from .autoscale import AutoscalePolicy
+        policy = AutoscalePolicy(
+            nprocs if min_replicas is None else min_replicas,
+            max_replicas,
+            up_qps=autoscale_up_qps, down_qps=autoscale_down_qps,
+            up_p99_ms=autoscale_up_p99_ms,
+            up_cooldown_sec=autoscale_up_cooldown_sec,
+            down_cooldown_sec=autoscale_down_cooldown_sec)
+    guard = None
+    publish_store = None
+    if publish_dir and health_port is not None and scrape_interval > 0:
+        from .autoscale import RollbackGuard
+        from .store import store_for
+        publish_store = store_for(publish_dir)
+        guard = RollbackGuard(
+            refuse_sec=rollback_grace_sec,
+            adopt_sec=max(1.0, 2.0 * scrape_interval))
     # fleet metrics plane (docs/OBSERVABILITY.md): the supervisor's
     # own jax-free /metrics at the base port, replica endpoints at
-    # base+1+rank via the exported env var; the supervision loop
-    # scrapes each live replica's NON-consuming {"cmd": "metrics"}
-    # verb on the scrape cadence into {"event": "fleet"} records
-    # (per-replica QPS / p99 / shed / restarts — ROADMAP 3(b)'s
-    # autoscaling signal; {"cmd": "stats"} would consume the daemon's
-    # own rate window, see _replica_metrics_row)
+    # base+1+rank via the exported env var; the scrape thread polls
+    # each live replica's NON-consuming {"cmd": "metrics"} verb on the
+    # scrape cadence into {"event": "fleet"} records (per-replica QPS
+    # / p99 / shed / restarts — the autoscaling signal; {"cmd":
+    # "stats"} would consume the daemon's own rate window, see
+    # _replica_metrics_row)
     if metrics_port:
         from ..obs.export import ensure_metrics_server
-        ensure_metrics_server(metrics_port)
+        ensure_metrics_server(
+            metrics_port,
+            extra_families=policy.metrics_families if policy else None)
         base_env["LIGHTGBM_TPU_METRICS_PORT"] = str(metrics_port + 1)
     telem = _FleetTelemetry(
         fleet_telemetry_path(base_env) if scrape_interval > 0
         else None)
-    next_scrape = time.monotonic() + max(0.0, scrape_interval)
     fleet = [_Replica(rank) for rank in range(nprocs)]
     last_rc = 1
     next_ping = time.monotonic() + max(0.0, health_grace)
+    next_store_poll = time.monotonic()
+    stop_scrape = threading.Event()
+
+    def _scrape_loop() -> None:
+        # the SCRAPE THREAD: a wedged replica's fetch timeout lands
+        # here, never in the supervision loop; every observation feeds
+        # the (lock-guarded) scaling and rollback policies
+        while not stop_scrape.wait(max(0.1, scrape_interval)):
+            try:
+                record = _scrape_fleet(list(fleet), health_port,
+                                       health_timeout)
+                telem.write(record)
+                _drain_spans_into(telem)
+                if policy is not None:
+                    policy.observe(record["replicas"])
+                if guard is not None:
+                    guard.observe(record["replicas"])
+            except Exception:
+                pass             # scraping must never kill the fleet
+
+    scraper = (threading.Thread(target=_scrape_loop, daemon=True,
+                                name="fleet-scrape")
+               if scrape_interval > 0 else None)
+
+    def _n_active() -> int:
+        return sum(1 for rep in fleet
+                   if not rep.done and not rep.retiring)
+
+    def _set_active_gauge() -> None:
+        try:
+            from ..obs.registry import registry
+            registry.gauge("fleet_replicas_active").set(_n_active())
+        except Exception:
+            pass
+
+    def _scale_up(reason: str) -> None:
+        if budget.total >= budget.max_restarts:
+            log_warning("elastic: autoscale up refused — the restart "
+                        "budget is spent; a crash-looping fleet must "
+                        "not grow")
+            return
+        used = {rep.rank for rep in fleet if not rep.done}
+        target = next((r for r in range(policy.max_replicas)
+                       if r not in used), None)
+        if target is None:
+            return      # every rank slot is occupied (e.g. draining)
+        rep = next((r for r in fleet if r.rank == target), None)
+        if rep is None:
+            rep = _Replica(target)
+            fleet.append(rep)
+        else:
+            rep.generation += 1          # fresh log file per life
+            rep.consecutive_restarts = 0
+        rep.done = False
+        rep.retiring = False
+        rep.relaunch_at = None
+        rep.restart_t0 = None
+        _launch_replica(rep, cmd, nprocs, log_dir, base_env)
+        n_active = _n_active()
+        log_info(f"elastic: autoscale up -> {n_active} replicas "
+                 f"(spawned rank {target}: {reason})")
+        _count("fleet_scale_ups")
+        _set_active_gauge()
+        telem.write({"event": "autoscale", "action": "up",
+                     "rank": target, "replicas": n_active,
+                     "reason": reason, "time": time.time()})
+
+    def _scale_down(reason: str, now: float) -> None:
+        victims = [rep for rep in fleet
+                   if not rep.done and not rep.retiring
+                   and rep.relaunch_at is None
+                   and rep.proc is not None
+                   and rep.proc.poll() is None]
+        if not victims:
+            return
+        rep = max(victims, key=lambda r: r.rank)
+        rep.retiring = True
+        rep.retire_deadline = now + max(1.0, retire_grace_sec)
+        _term_group(rep.proc)
+        n_active = _n_active()
+        log_info(f"elastic: autoscale down -> {n_active} replicas "
+                 f"(draining rank {rep.rank}: {reason})")
+        _count("fleet_scale_downs")
+        _set_active_gauge()
+        telem.write({"event": "autoscale", "action": "down",
+                     "rank": rep.rank, "replicas": n_active,
+                     "reason": reason, "time": time.time()})
+
+    def _check_rollback() -> None:
+        from .publisher import (MANIFEST_SUFFIX, PublishError,
+                                latest_manifest_in,
+                                rollback_publication)
+        try:
+            found = latest_manifest_in(publish_store)
+        except (OSError, PublishError):
+            found = None
+        if found is not None:
+            guard.note_publication(
+                found[0], str(found[1].get("sha256") or ""))
+        order = guard.decide()
+        if order is None:
+            return
+        event = {"event": "rollback", "bad_file": order["bad_name"],
+                 "bad_sha": order["bad_sha"],
+                 "good_file": order["good_name"],
+                 "good_sha": order["good_sha"], "time": time.time()}
+        log_warning(f"elastic: rolling back publication "
+                    f"{order['bad_name']} "
+                    f"(sha {str(order['bad_sha'])[:12]}…) — the "
+                    "fleet refused or degraded on it")
+        try:
+            if order["good_name"]:
+                new_manifest = rollback_publication(
+                    publish_store, order["bad_name"],
+                    order["good_name"])
+                event["republished"] = new_manifest["file"]
+            else:
+                # no last-known-good yet: withdrawing the bad
+                # publication is all a supervisor can do
+                publish_store.delete(order["bad_name"])
+                publish_store.delete(
+                    order["bad_name"] + MANIFEST_SUFFIX)
+                event["republished"] = None
+            event["ok"] = True
+        except (OSError, PublishError) as e:
+            event["ok"] = False
+            event["error"] = str(e)
+            log_warning(f"elastic: rollback of {order['bad_name']} "
+                        f"failed ({e})")
+        _count("fleet_rollbacks")
+        telem.write(event)
+
     try:
         for rep in fleet:
             _launch_replica(rep, cmd, nprocs, log_dir, base_env)
+        _set_active_gauge()
+        if scraper is not None:
+            scraper.start()
         while True:
             now = time.monotonic()
             ping_round = health_port is not None and now >= next_ping
             if ping_round:
                 next_ping = now + max(0.1, health_interval)
-            if scrape_interval > 0 and now >= next_scrape:
-                next_scrape = now + scrape_interval
-                telem.write(_scrape_fleet(fleet, health_port,
-                                          health_timeout))
-                _drain_spans_into(telem)
-            for rep in fleet:
+            for rep in list(fleet):
                 if rep.done:
                     continue
                 if rep.relaunch_at is not None:
@@ -769,6 +1024,20 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
                 rc = rep.proc.poll()
                 needs_restart = False
                 if rc is not None:
+                    if rep.retiring:
+                        # a draining replica's exit ends its life —
+                        # never a restart, whatever the code
+                        rep.retiring = False
+                        rep.done = True
+                        if rc == 0:
+                            log_info(f"elastic: replica {rep.rank} "
+                                     "retired cleanly (drained)")
+                        else:
+                            log_warning(
+                                f"elastic: retiring replica "
+                                f"{rep.rank} exited with code {rc} "
+                                "during its drain")
+                        continue
                     if rc == 0:
                         log_info(f"elastic: replica {rep.rank} exited "
                                  "cleanly")
@@ -778,6 +1047,22 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
                     log_warning(f"elastic: replica {rep.rank} exited "
                                 f"with code {rc}")
                     needs_restart = True
+                elif rep.retiring:
+                    # draining: no health pings, no restarts — but a
+                    # drain that outlives its deadline is a wedge
+                    if now >= rep.retire_deadline:
+                        log_warning(
+                            f"elastic: replica {rep.rank} did not "
+                            f"finish draining within "
+                            f"{retire_grace_sec:g}s; killing it")
+                        _kill_group(rep.proc)
+                        try:
+                            rep.proc.wait(timeout=max(1.0, grace))
+                        except subprocess.TimeoutExpired:
+                            _kill_group(rep.proc)
+                        rep.retiring = False
+                        rep.done = True
+                    continue
                 elif ping_round and \
                         now - rep.launched_at >= health_grace:
                     if replica_ping(health_port + rep.rank,
@@ -792,6 +1077,11 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
                                 f"{rep.ping_failures} consecutive "
                                 "health checks (alive but wedged); "
                                 "killing it for relaunch")
+                            if guard is not None:
+                                # post-swap health failure: condemn
+                                # the publication this replica serves
+                                # if it is the one under watch
+                                guard.note_eviction(rep.rank)
                             _kill_group(rep.proc)
                             try:
                                 rep.proc.wait(timeout=max(1.0, grace))
@@ -822,11 +1112,28 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
                                 and other.proc.poll() is None:
                             _kill_group(other.proc)
                     return last_rc
+            if policy is not None:
+                decision = policy.decide(_n_active())
+                if decision is not None:
+                    action, reason = decision
+                    if action == "up":
+                        _scale_up(reason)
+                    else:
+                        _scale_down(reason, now)
+            if guard is not None and now >= next_store_poll:
+                next_store_poll = now + max(0.5, scrape_interval)
+                try:
+                    _check_rollback()
+                except Exception:
+                    pass    # rollback must never kill the supervisor
             if all(rep.done for rep in fleet):
                 log_info("elastic: every replica exited cleanly")
                 if scrape_interval > 0:
                     # final scrape: the restart totals survive into
                     # the stream even when the cadence never fired
+                    stop_scrape.set()
+                    if scraper is not None:
+                        scraper.join(timeout=health_timeout + 2.0)
                     telem.write(_scrape_fleet(fleet, None,
                                               health_timeout))
                     _drain_spans_into(telem)
@@ -838,6 +1145,9 @@ def supervise_fleet(nprocs: int, cmd: Sequence[str],
                 _kill_group(rep.proc)
         raise
     finally:
+        stop_scrape.set()
+        if scraper is not None and scraper.is_alive():
+            scraper.join(timeout=2.0)
         telem.close()
 
 
@@ -892,6 +1202,49 @@ def build_parser() -> argparse.ArgumentParser:
                    help="startup window in seconds during which a "
                         "(re)launched replica is not pinged (model "
                         "load + compile)")
+    p.add_argument("--min-replicas", type=int, default=None,
+                   help="autoscaling floor (fleet mode; default: "
+                        "nprocs)")
+    p.add_argument("--max-replicas", type=int, default=0,
+                   help="autoscaling ceiling (fleet mode): with this "
+                        "set (plus --scrape-interval and an up "
+                        "threshold) the supervisor spawns replicas "
+                        "under load and SIGTERM-drains the highest "
+                        "rank when traffic subsides (0 = fixed fleet)")
+    p.add_argument("--autoscale-up-qps", type=float, default=0.0,
+                   help="scale up when fleet-total QPS exceeds this "
+                        "per active replica (0 = no QPS trigger)")
+    p.add_argument("--autoscale-down-qps", type=float, default=0.0,
+                   help="scale down when fleet-total QPS would still "
+                        "clear this per replica with one replica "
+                        "fewer; keep it below --autoscale-up-qps for "
+                        "hysteresis (0 = never scale down)")
+    p.add_argument("--autoscale-up-p99-ms", type=float, default=0.0,
+                   help="scale up when any replica's p99 latency "
+                        "exceeds this many ms (0 = no latency "
+                        "trigger)")
+    p.add_argument("--autoscale-up-cooldown", type=float, default=5.0,
+                   help="seconds after any scaling action before the "
+                        "next scale-up (default 5)")
+    p.add_argument("--autoscale-down-cooldown", type=float,
+                   default=15.0,
+                   help="seconds after any scaling action before the "
+                        "next scale-down (default 15)")
+    p.add_argument("--retire-grace", type=float, default=30.0,
+                   help="seconds a scaled-down replica gets to finish "
+                        "its SIGTERM drain before being killed "
+                        "(default 30)")
+    p.add_argument("--publish-dir", default=None,
+                   help="publication store target the fleet swaps "
+                        "from (a directory or mem:// spec): enables "
+                        "the rollback guard — a publication the "
+                        "fleet's canary gates refuse, or whose "
+                        "adopter fails post-swap health checks, is "
+                        "rolled back to last-known-good")
+    p.add_argument("--rollback-grace", type=float, default=6.0,
+                   help="seconds the fleet gets to adopt a new "
+                        "publication before mounting swap failures "
+                        "condemn it (default 6)")
     p.add_argument("--metrics-port", type=int, default=0,
                    help="fleet metrics plane (docs/OBSERVABILITY.md): "
                         "the supervisor serves its own jax-free "
@@ -956,7 +1309,17 @@ def main(argv: Optional[List[str]] = None) -> int:
                 health_fails=args.health_fails,
                 health_grace=args.health_grace,
                 metrics_port=args.metrics_port or None,
-                scrape_interval=args.scrape_interval)
+                scrape_interval=args.scrape_interval,
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas or None,
+                autoscale_up_qps=args.autoscale_up_qps,
+                autoscale_down_qps=args.autoscale_down_qps,
+                autoscale_up_p99_ms=args.autoscale_up_p99_ms,
+                autoscale_up_cooldown_sec=args.autoscale_up_cooldown,
+                autoscale_down_cooldown_sec=args.autoscale_down_cooldown,
+                retire_grace_sec=args.retire_grace,
+                publish_dir=args.publish_dir,
+                rollback_grace_sec=args.rollback_grace)
         return supervise(args.nprocs, cmd,
                          max_restarts=args.max_restarts,
                          port=args.port or None, log_dir=args.log_dir,

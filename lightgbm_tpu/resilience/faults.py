@@ -50,6 +50,17 @@ train -> publish -> serve loop):
   shape the atomic helper exists to prevent) and fails, exercising
   both the publisher's retry/backoff loop and the serve watcher's
   manifest validation + skip-and-retry path.
+- ``store_outage@G`` — the generation-``G`` publication's artifact
+  store (resilience/store.py) is down for one attempt: the publisher's
+  first put raises a transport error, exercising the jittered
+  retry/backoff loop over the store interface; the fleet keeps serving
+  the current model until the retried publication lands.
+- ``publish_poison@G`` — the generation-``G`` publication is
+  byte-valid (manifest sha256 matches the model blob) but its canary
+  expectations are garbage — the shape of a trainer that published a
+  model that scores nonsense. sha256 validation accepts it; only the
+  serve-side canary gate (docs/SERVING.md) refuses it, and the fleet
+  supervisor rolls the publication back to last-known-good.
 - ``serve_kill@N`` — ``SIGKILL`` the serving daemon at its ``N``-th
   accepted predict request, *before* the request enters the batcher
   (an accepted request must never be silently dropped — a killed
@@ -80,7 +91,8 @@ __all__ = ["FaultPlan", "InjectedResourceExhausted", "InjectedInitRefused",
 
 _KNOWN_KINDS = ("nan_grad", "nan_hess", "oom", "kill",
                 "rank_kill", "stall_rank", "init_refuse",
-                "publish_torn", "serve_kill", "refit_nan")
+                "publish_torn", "publish_poison", "store_outage",
+                "serve_kill", "refit_nan")
 
 #: process-level fault event log for faults that have no engine to hang
 #: off (init retries, watchdog timeouts, distributed injections). The

@@ -17,17 +17,27 @@ A stdlib-socket JSON-lines server over one compiled forest
       {"cmd": "shutdown"}               -> stops the daemon (testing /
                                            drains first)
 
-- **Hot model swap**: ``--watch-dir`` polls a directory for the newest
-  model artifact — ``ckpt_*.npz`` training snapshots
-  (resilience/checkpoint.py) or ``*.txt`` model files, both written
-  via the same-dir-tmp + ``os.replace`` atomic convention
-  (utils/atomic.py) — compiles it off the serving path, and swaps it
+- **Hot model swap**: ``--watch-dir`` polls a watch target — a local
+  directory, or any artifact-store spec (``mem://<name>``, an
+  :class:`~..resilience.store.ArtifactStore`; resilience/store.py) —
+  for the newest model artifact: ``ckpt_*.npz`` training snapshots
+  (resilience/checkpoint.py, local targets only) or ``*.txt`` model
+  files, both written via the store's all-or-nothing put (the
+  same-dir-tmp + ``os.replace`` convention on a local directory,
+  utils/atomic.py) — compiles it off the serving path, and swaps it
   into the batcher. In-flight requests finish on the model they
   started with; the old forest's HBM is donated to the new upload.
   Artifacts published with a manifest sidecar
   (resilience/publisher.py, docs/PIPELINE.md) are sha256-validated
   first: a TORN publication is skipped with a ``swap_failure`` fault
-  event and retried next poll, never served.
+  event and retried next poll, never served. A manifest that embeds a
+  **canary** (validation rows + the publisher's expected raw scores)
+  gates the swap harder: the staged forest scores the canary through
+  the real compiled path BEFORE the swap is offered, and a mismatch
+  refuses the swap with a ``canary_refused`` fault event — a
+  byte-valid-but-wrong publication (``publish_poison``) never serves.
+  A store outage mid-poll degrades to serving the current model (with
+  a warning + fault event), never a crash.
 
 - **Overload policy**: beyond the hard ``QueueFullError`` admission
   wall, ``--shed-queue-rows`` / ``--shed-p99-ms`` shed the OLDEST
@@ -37,6 +47,11 @@ A stdlib-socket JSON-lines server over one compiled forest
 - **Graceful shutdown**: SIGTERM and the ``shutdown`` command drain
   accepted requests (bounded by ``--grace``) before the socket
   closes — a supervised restart never drops an accepted request.
+  During the drain the daemon keeps ACCEPTING briefly and answers new
+  predict requests with a typed ``{"error": "draining"}`` reply — a
+  connection parked in the TCP accept backlog at SIGTERM gets a fast
+  typed refusal to retry elsewhere, never a hang against a
+  closed-but-unaccepted socket (docs/SERVING.md "Shutdown").
 
 - **Telemetry**: ``{"event": "serve"}`` JSONL lines every
   ``--stats-interval`` seconds (QPS, queue depth, p50/p99 latency,
@@ -104,6 +119,7 @@ class ServeState:
         self._shed_replies = 0
         self._requests_accepted = 0
         self._active_handlers = 0
+        self._draining = False
         self._last_stats: Dict[str, Any] = {}
         # newest computed rates (qps / rows_per_sec), cached so the
         # /metrics scrape can export them WITHOUT consuming the
@@ -181,6 +197,17 @@ class ServeState:
     def request_shutdown(self) -> None:
         self.shutdown_event.set()
 
+    def begin_drain(self) -> None:
+        """Flip predict requests to the typed ``{"error": "draining"}``
+        refusal; ``ping``/``stats``/``metrics`` keep answering so the
+        supervisor can observe the retirement."""
+        with self._lock:
+            self._draining = True
+
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
     # -- telemetry -----------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         """The ``stats`` protocol reply / serve-event payload.
@@ -201,6 +228,7 @@ class ServeState:
             manifest = dict(self._manifest) if self._manifest else None
             failures = self._swap_failures
             shed_replies = self._shed_replies
+            draining = self._draining
             last = dict(self._last_stats)
             uptime = time.monotonic() - self._t0
             recompiles = {"delta": self._watcher.delta(),
@@ -217,6 +245,7 @@ class ServeState:
         out["manifest"] = manifest
         out["swap_failures"] = failures
         out["shed_replies"] = shed_replies
+        out["draining"] = draining
         out["uptime_s"] = round(uptime, 3)
         out["qps"] = round(dreq / dt, 3) if dt > 0 else 0.0
         out["rows_per_sec"] = round(drows / dt, 3) if dt > 0 else 0.0
@@ -245,6 +274,10 @@ class ServeState:
         with self._lock:
             model_id = self._model_id
             rates = dict(self._last_rates)
+            # the serving model's publication sha rides the info gauge
+            # so the fleet supervisor's rollback guard can see WHICH
+            # publication each replica runs (resilience/autoscale.py)
+            sha = (self._manifest or {}).get("sha256") or ""
         fams: Dict[str, Any] = {
             "serve_requests": counter_family(snap["requests_total"]),
             "serve_rows": counter_family(snap["rows_total"]),
@@ -259,7 +292,8 @@ class ServeState:
             "serve_qps": gauge_family(rates.get("qps")),
             "serve_rows_per_sec":
                 gauge_family(rates.get("rows_per_sec")),
-            "serve_model_info": gauge_family(1, model=str(model_id)),
+            "serve_model_info": gauge_family(1, model=str(model_id),
+                                             sha=str(sha)),
         }
         for key in ("bytes_in_use", "peak_bytes_in_use"):
             if hbm.get(key) is not None:
@@ -368,6 +402,12 @@ def handle_request(obj: Any, state: ServeState) -> Dict[str, Any]:
     if rows is None:
         return {"error": "expected 'rows' (list of feature rows), "
                          "'features' (one row) or 'cmd'"}
+    if state.draining():
+        # graceful shutdown in progress: a typed refusal, not a hang —
+        # the client retries on another replica immediately instead of
+        # waiting out a connection that is about to close
+        return {"error": "draining", "draining": True,
+                "model": state.model_id()}
     import numpy as np
     try:
         X = np.asarray(rows, np.float32)
@@ -500,28 +540,58 @@ class _Server(socketserver.ThreadingTCPServer):
 # model loading + watching
 # ---------------------------------------------------------------------
 
-def _find_model_artifact(directory: str) \
-        -> Optional[Tuple[float, str]]:
-    """Newest model artifact in ``directory``: (mtime, path) over
-    ``ckpt_*.npz`` snapshots and ``*.txt`` model files."""
+def _is_model_name(name: str, local: bool) -> bool:
+    """Artifact names the watcher considers: model text everywhere,
+    checkpoint snapshots only on local targets (load_snapshot needs a
+    real file; a cross-machine store publishes model text)."""
+    if name.endswith(".txt"):
+        return True
+    return local and name.startswith("ckpt_") and name.endswith(".npz")
+
+
+def _member_id(store, name: str) -> str:
+    """Stable identity of one store member — the joined PATH on a
+    local directory (the PR-12 watch keys, byte-for-byte), the
+    ``url/name`` spec elsewhere."""
+    from ..resilience.store import LocalDirStore
+    if isinstance(store, LocalDirStore):
+        return os.path.join(store.directory, name)
+    return f"{store.url}/{name}"
+
+
+def _find_model_artifact_in(store) -> Optional[Tuple[float, str]]:
+    """Newest model artifact NAME in ``store``: (mtime, name).
+
+    Raises ``OSError`` (``StoreError``) when the store itself cannot
+    be listed — the watcher turns that into degraded-but-serving."""
+    from ..resilience.store import LocalDirStore
+    local = isinstance(store, LocalDirStore)
     best: Optional[Tuple[float, str]] = None
-    try:
-        names = os.listdir(directory)
-    except OSError:
-        return None
-    for name in names:
-        if not ((name.startswith("ckpt_") and name.endswith(".npz"))
-                or name.endswith(".txt")):
+    for name in store.list_names():
+        if not _is_model_name(name, local):
             continue
-        path = os.path.join(directory, name)
-        try:
-            mtime = os.path.getmtime(path)
-        except OSError:
+        st = store.stat(name)
+        if st is None:
             continue
-        key = (mtime, path)
+        key = (st[0], name)
         if best is None or key > best:
             best = key
     return best
+
+
+def _find_model_artifact(directory: str) \
+        -> Optional[Tuple[float, str]]:
+    """Newest model artifact in directory ``directory``:
+    (mtime, path)."""
+    from ..resilience.store import LocalDirStore
+    try:
+        found = _find_model_artifact_in(LocalDirStore(directory))
+    except OSError:
+        return None
+    if found is None:
+        return None
+    mtime, name = found
+    return (mtime, os.path.join(directory, name))
 
 
 def _load_booster(path: str):
@@ -543,28 +613,57 @@ def _load_booster(path: str):
     return booster
 
 
+def _load_booster_in(store, name: str):
+    """A Booster from one store member; local targets keep the
+    path-based loader (checkpoint snapshots need a real file)."""
+    from ..resilience.store import LocalDirStore
+    if isinstance(store, LocalDirStore):
+        return _load_booster(os.path.join(store.directory, name))
+    from ..basic import Booster, LightGBMError
+    booster = Booster(
+        model_str=store.get_bytes(name).decode("utf-8"))
+    if not booster._models:
+        raise LightGBMError(f"{_member_id(store, name)}: parsed to a "
+                            "model with no trees")
+    return booster
+
+
 def _artifact_key(path: str) -> Tuple[str, float, int]:
     st = os.stat(path)
     return (path, st.st_mtime, st.st_size)
 
 
-class _Watcher:
-    """Polls ``watch_dir`` and hot-swaps the newest model artifact
-    into the batcher. Runs on its own thread; compilation happens here,
-    off the serving path, and the swap itself is one locked pointer
-    exchange inside the batcher."""
+def _artifact_key_in(store, name: str) -> Tuple[str, float, int]:
+    """(identity, mtime, size) — the same key :func:`_artifact_key`
+    produces for a local-directory member, so watch state primed from
+    a path keeps matching once the watcher polls through a store."""
+    st = store.stat(name)
+    if st is None:
+        raise FileNotFoundError(_member_id(store, name))
+    return (_member_id(store, name), st[0], st[1])
 
-    def __init__(self, state: ServeState, watch_dir: str,
+
+class _Watcher:
+    """Polls a watch target (directory / store spec / ArtifactStore)
+    and hot-swaps the newest model artifact into the batcher. Runs on
+    its own thread; compilation happens here, off the serving path,
+    and the swap itself is one locked pointer exchange inside the
+    batcher."""
+
+    def __init__(self, state: ServeState, watch_dir,
                  interval_s: float, compile_kwargs: Dict[str, Any],
                  current_key: Optional[Tuple[str, float, int]],
                  warmup_rows: Optional[int]):
+        from ..resilience.store import store_for
         self.state = state
-        self.watch_dir = watch_dir
+        self.store = store_for(watch_dir)
+        self.watch_dir = self.store.url
         self.interval_s = max(0.05, float(interval_s))
         self.compile_kwargs = dict(compile_kwargs)
         self.warmup_rows = warmup_rows
         self._last_key = current_key
         self._failed_key: Optional[Tuple[str, float, int]] = None
+        self._degraded = False
         self._thread = threading.Thread(
             target=self._loop, daemon=True,
             name="lightgbm-tpu-serve-watcher")
@@ -579,14 +678,32 @@ class _Watcher:
     def poll_once(self) -> bool:
         """One poll; True when a swap happened (tests call this
         directly for determinism)."""
-        found = _find_model_artifact(self.watch_dir)
+        try:
+            found = _find_model_artifact_in(self.store)
+        except OSError as e:
+            # a store outage must DEGRADE, not crash the watcher
+            # thread: keep serving the current model, say so once per
+            # outage episode, retry next poll
+            if not self._degraded:
+                self._degraded = True
+                log_warning(f"serve: watch target {self.watch_dir!r} "
+                            f"unreachable ({e}); serving the current "
+                            "model and retrying next poll")
+                from ..resilience.faults import record_fault_event
+                record_fault_event(
+                    "store_outage", action="degraded",
+                    detail=f"watch target {self.watch_dir} "
+                           f"unreachable: {e}")
+            return False
+        self._degraded = False
         if found is None:
             return False
-        _, path = found
+        _, name = found
         try:
-            key = _artifact_key(path)
+            key = _artifact_key_in(self.store, name)
         except OSError:
             return False
+        path = _member_id(self.store, name)
         # self._last_key/_failed_key are only touched on this thread
         # (and the constructor, which runs before it starts)
         if key == self._last_key:
@@ -598,11 +715,11 @@ class _Watcher:
             # and model writes, or a non-atomic writer is mid-way —
             # and must be skipped, not served. Unmanaged artifacts
             # (no sidecar) keep the legacy trust-once-it-parses path.
-            from ..resilience.publisher import validate_artifact
+            from ..resilience.publisher import validate_artifact_in
             t_poll = time.perf_counter()
-            manifest = validate_artifact(path)
+            manifest = validate_artifact_in(self.store, name)
             t_valid = time.perf_counter()
-            booster = _load_booster(path)
+            booster = _load_booster_in(self.store, name)
             t_load = time.perf_counter()
             from .compile import compile_forest
             old = self.state.batcher._current_forest()
@@ -615,14 +732,21 @@ class _Watcher:
                     f"new model expects {staged.n_features} features, "
                     f"the served one {old.n_features} — clients would "
                     "break; refusing the swap")
+            canary_forest = self._score_canary(manifest, staged, key)
             # the swap rides the request queue: the worker applies it
-            # between batches, where the old forest is provably idle,
-            # so attach() can DONATE its device buffers field-by-field
-            # to the new upload — the transient HBM overhead is one
-            # field, never a second resident forest
+            # between batches, where the old forest is provably idle.
+            # On the canary path the new forest is ALREADY attached
+            # (it had to predict for real); otherwise attach() DONATES
+            # the old forest's device buffers field-by-field to the
+            # new upload — the transient HBM overhead is one field,
+            # never a second resident forest
             t_stage = time.perf_counter()
-            fut = self.state.batcher.swap_deferred(
-                lambda old_forest: staged.attach(reuse=old_forest))
+            if canary_forest is not None:
+                fut = self.state.batcher.swap_deferred(
+                    lambda old_forest: canary_forest)
+            else:
+                fut = self.state.batcher.swap_deferred(
+                    lambda old_forest: staged.attach(reuse=old_forest))
             try:
                 forest = fut.result(timeout=300)
             except Exception:
@@ -671,6 +795,54 @@ class _Watcher:
                 log_warning(f"serve: post-swap warmup failed ({e}); "
                             "buckets will compile on demand")
         return True
+
+    def _score_canary(self, manifest, staged, key):
+        """Canary gate (docs/SERVING.md): score the manifest's
+        embedded validation rows through the REAL compiled forest
+        before the swap is offered. Returns the attached forest on a
+        pass (it is the one the swap installs — what was validated is
+        what serves), None when the publication carries no canary or
+        the serve-side ``--num-iteration`` trim makes the publisher's
+        full-model expectations inapplicable; raises on a mismatch
+        (the ``publish_poison`` shape), which the caller's failure
+        path turns into an unswapped retry."""
+        canary = (manifest or {}).get("canary")
+        if not canary:
+            return None
+        trim = self.compile_kwargs.get("num_iteration")
+        if trim is not None and int(trim) > 0:
+            log_info("serve: skipping canary validation (serving a "
+                     f"--num-iteration {int(trim)} trim; the canary "
+                     "scores the full published model)")
+            return None
+        import numpy as np
+        rows = np.asarray(canary.get("rows"), np.float32)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        want = np.asarray(canary.get("scores"),
+                          np.float64).reshape(-1)
+        tol = float(canary.get("tol", 1e-3))
+        # a plain attach — NO buffer donation: the old forest is still
+        # serving traffic while the canary runs
+        forest = staged.attach()
+        got = np.asarray(forest.predict_raw(rows),
+                         np.float64).reshape(-1)
+        if got.shape != want.shape \
+                or not np.allclose(got, want, rtol=0.0, atol=tol):
+            worst = (float(np.max(np.abs(got - want)))
+                     if got.shape == want.shape else float("inf"))
+            if key != self._failed_key:   # once per observed artifact
+                from ..resilience.faults import record_fault_event
+                record_fault_event(
+                    "canary_refused", action="refused_swap",
+                    detail=f"canary mismatch on {key[0]}: worst "
+                           f"|raw - expected| {worst:.6g} > tol "
+                           f"{tol:g} over {int(rows.shape[0])} rows")
+            raise ValueError(
+                f"canary validation failed: worst |raw - expected| "
+                f"{worst:.6g} exceeds tol {tol:g} — the publication "
+                "is byte-valid but scores wrong; refusing the swap")
+        return forest
 
     @staticmethod
     def _record_swap_spans(manifest, path: str, model_id,
@@ -828,7 +1000,9 @@ def _resolve_model(args) -> Tuple[str, Optional[str]]:
         model = found[1]
     elif not os.path.exists(model):
         raise FileNotFoundError(f"model file not found: {model!r}")
-    if watch_dir is not None and not os.path.isdir(watch_dir):
+    if watch_dir is not None \
+            and not str(watch_dir).startswith("mem://") \
+            and not os.path.isdir(watch_dir):
         raise FileNotFoundError(
             f"--watch-dir is not a directory: {watch_dir!r}")
     return model, watch_dir
@@ -940,19 +1114,38 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError:
         pass      # not the main thread (embedded use): skip the hook
     try:
-        state.shutdown_event.wait()
+        # a TIMED wait, not a bare .wait(): the C-level signal flag is
+        # only processed by the main thread running bytecode, and a
+        # process-directed SIGTERM can be delivered to any thread —
+        # the periodic wake guarantees the handler runs even when the
+        # kernel picked a worker thread (e.g. a signal queued while
+        # the process was SIGSTOPped)
+        while not state.shutdown_event.wait(0.5):
+            pass
     except KeyboardInterrupt:
         pass
     # ---- graceful drain (bounded by --grace) ----
-    # order matters: stop ACCEPTING first, then drain what was already
-    # accepted, then wait for handler threads to put the replies on
-    # the wire — only then close the socket. A request the daemon
-    # accepted is answered or the client sees the connection close;
-    # it is never silently dropped by a supervised restart.
+    # order matters: flip predict requests to the typed draining
+    # refusal first, drain what was already accepted, wait for handler
+    # threads to put the replies on the wire — and only THEN stop
+    # accepting. Accepting stays open through the drain (plus a short
+    # linger) so a connection parked in the kernel's TCP accept
+    # backlog at SIGTERM is accepted and answered with
+    # {"error": "draining"} instead of being reset by the socket close
+    # below. A request the daemon accepted is answered or the client
+    # sees the connection close; it is never silently dropped by a
+    # supervised restart.
     deadline = time.monotonic() + max(0.0, float(args.grace))
-    server.shutdown()                        # no new connections
+    state.begin_drain()
     state.batcher.close(
         timeout=max(0.1, deadline - time.monotonic()))
+    while state.active_handlers() > 0 \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    linger = min(0.5, max(0.0, deadline - time.monotonic()))
+    if linger > 0:
+        time.sleep(linger)                  # sweep the accept backlog
+    server.shutdown()                        # no new connections
     while state.active_handlers() > 0 \
             and time.monotonic() < deadline:
         time.sleep(0.05)

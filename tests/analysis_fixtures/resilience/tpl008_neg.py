@@ -1,0 +1,37 @@
+# tpulint fixture: TPL008 negative — the same autoscaling policy as
+# resilience/tpl008_pos.py with every scrape/decide-shared field
+# guarded by one common lock (the resilience/autoscale.py discipline:
+# observations in on the scrape thread, decisions out on the
+# supervision loop, every byte of shared state under self._lock).
+# No EXPECT lines.
+import threading
+
+
+class Policy:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.qps = 0.0
+        self.seq = 0
+        self.scale_ups = 0
+        self._scraper = threading.Thread(target=self._scrape_loop,
+                                         daemon=True)
+        self._scraper.start()
+
+    def _scrape_loop(self):
+        while True:
+            with self._lock:
+                self.qps = 12.5
+                self.seq += 1
+
+    def decide(self, n_active):
+        with self._lock:
+            if self.seq == 0:
+                return None
+            if self.qps > n_active * 10.0:
+                self.scale_ups += 1
+                return "up"
+            return None
+
+    def snapshot(self):
+        with self._lock:
+            return {"qps": self.qps, "ups": self.scale_ups}

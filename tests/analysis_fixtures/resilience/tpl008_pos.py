@@ -1,0 +1,35 @@
+# tpulint fixture: TPL008 positive — an autoscaling policy whose
+# scrape thread feeds observations into fields the supervision loop's
+# decide() reads and mutates with no lock. This is the "strip the
+# autoscaler lock" acceptance shape: resilience/tpl008_neg.py is the
+# same policy WITH the lock, and removing it must re-surface these
+# findings.
+import threading
+
+
+class Policy:
+    def __init__(self):
+        self.qps = 0.0
+        self.seq = 0
+        self.scale_ups = 0
+        self._scraper = threading.Thread(target=self._scrape_loop,
+                                         daemon=True)
+        self._scraper.start()
+
+    def _scrape_loop(self):
+        while True:
+            # EXPECT: TPL008
+            self.qps = 12.5
+            # EXPECT: TPL008
+            self.seq += 1
+
+    def decide(self, n_active):
+        if self.seq == 0:
+            return None
+        if self.qps > n_active * 10.0:
+            self.scale_ups += 1
+            return "up"
+        return None
+
+    def snapshot(self):
+        return {"qps": self.qps, "ups": self.scale_ups}

@@ -1,0 +1,842 @@
+"""Self-healing serving fleet (ISSUE 17, docs/RESILIENCE.md).
+
+Layers under test:
+
+1. Artifact-store transport (resilience/store.py): local-dir and
+   object-store-shaped backends behind one interface, with injectable
+   latency / outage / torn-write faults on the memory backend, and
+   the process-shared ``mem://`` registry.
+2. Publisher over stores (resilience/publisher.py): manifest-first
+   publication through any store, store_outage retry/backoff,
+   publish_poison (byte-valid, canary-garbage), publish_keep
+   retention with protected shas, and rollback republication.
+3. Autoscaling + rollback policy (resilience/autoscale.py):
+   hysteresis scaling decisions from the fleet scrape signal, and the
+   watching -> adopted | rolled-back publication state machine.
+4. Canary gate (serve/daemon.py): a poisoned publication is refused
+   BEFORE the swap with a canary_refused fault event; a valid canary
+   passes and the validated forest is the one installed.
+5. Drain + scrape robustness: a connection parked in the TCP accept
+   backlog across a SIGTERM drain gets a typed {"error": "draining"}
+   reply (never a hang), and a wedged replica (accepts TCP, never
+   replies) is marked dead without stalling the scrape round.
+6. (slow) The ISSUE 17 chaos e2e: load-spike autoscaling up AND back
+   down, a store outage mid-publish carried by retry/backoff, and a
+   poisoned generation refused by every canary gate and rolled back
+   to last-known-good by the fleet supervisor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu.resilience.autoscale import (  # noqa: E402
+    AutoscalePolicy, RollbackGuard)
+from lightgbm_tpu.resilience.publisher import (  # noqa: E402
+    MANIFEST_SUFFIX, PublishError, latest_manifest, latest_manifest_in,
+    load_manifest_in, prune_publications, publish_model,
+    rollback_publication, validate_artifact_in)
+from lightgbm_tpu.resilience.store import (  # noqa: E402
+    LocalDirStore, MemoryBackend, ObjectStore, StoreError, store_for)
+
+from tests._mp_utils import REPO_DIR, kill_group  # noqa: E402
+from tests.conftest import make_synthetic_binary  # noqa: E402
+
+
+def _train(params, X, y, rounds=5, **kwargs):
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    return lgb.train({"verbosity": -1, **params}, ds,
+                     num_boost_round=rounds, **kwargs)
+
+
+@pytest.fixture(scope="module")
+def binary_model():
+    X, y = make_synthetic_binary(n=900, f=8)
+    bst = _train({"objective": "binary", "num_leaves": 15}, X, y)
+    return bst, X, y
+
+
+def _canary_for(bst, X, rows=4, tol=1e-3):
+    """The publisher-side canary batch: float32 rows (what the serve
+    path feeds the forest) scored through the reference predictor."""
+    c_rows = np.asarray(X[:rows], np.float32)
+    scores = bst.predict(c_rows.astype(np.float64),
+                         raw_score=True).reshape(-1)
+    return {"rows": c_rows.tolist(),
+            "scores": [float(s) for s in scores], "tol": tol}
+
+
+# ---------------------------------------------------------------------
+# 1. artifact-store transport
+# ---------------------------------------------------------------------
+
+def test_local_dir_store_roundtrip(tmp_path):
+    store = LocalDirStore(str(tmp_path / "pub"))
+    assert store.list_names() == []          # missing dir: empty, no raise
+    store.put_bytes("a.txt", b"hello")
+    store.put_bytes("b.txt", b"world!!")
+    assert store.get_bytes("a.txt") == b"hello"
+    assert sorted(store.list_names()) == ["a.txt", "b.txt"]
+    mtime, size = store.stat("b.txt")
+    assert size == 7 and mtime > 0
+    assert store.stat("missing.txt") is None
+    with pytest.raises(FileNotFoundError):
+        store.get_bytes("missing.txt")
+    store.delete("a.txt")
+    store.delete("a.txt")                    # idempotent
+    assert store.list_names() == ["b.txt"]
+
+
+def test_memory_backend_outage_and_torn_put():
+    backend = MemoryBackend()
+    store = ObjectStore(backend, url="object://t")
+    store.put_bytes("m.txt", b"x" * 90)
+    backend.set_outage(2)
+    with pytest.raises(StoreError):
+        store.get_bytes("m.txt")
+    with pytest.raises(StoreError):
+        store.put_bytes("m.txt", b"y")
+    # outage over: verbs work again
+    assert store.get_bytes("m.txt") == b"x" * 90
+    # a torn put stores a prefix THEN raises — the crashed non-atomic
+    # writer shape manifest validation exists for
+    backend.tear_next_put()
+    with pytest.raises(StoreError):
+        store.put_bytes("m.txt", b"z" * 90)
+    torn = store.get_bytes("m.txt")
+    assert torn == b"z" * 30 and len(torn) < 90
+    assert backend.faults_injected == 3
+
+
+def test_store_for_registry_and_passthrough(tmp_path):
+    a = store_for("mem://registry-test")
+    b = store_for("mem://registry-test")
+    a.put_bytes("k", b"v")
+    assert b.get_bytes("k") == b"v"          # same process-shared backend
+    assert a.backend is b.backend
+    local = store_for(str(tmp_path))
+    assert isinstance(local, LocalDirStore)
+    assert store_for(local) is local         # ArtifactStore passthrough
+
+
+# ---------------------------------------------------------------------
+# 2. publisher over stores
+# ---------------------------------------------------------------------
+
+def test_publish_through_object_store(binary_model):
+    bst, X, _ = binary_model
+    store = ObjectStore(MemoryBackend(), url="object://pub")
+    manifest = publish_model(bst, store, "model_g0000.txt",
+                             metadata={"generation": 0},
+                             canary=_canary_for(bst, X))
+    assert validate_artifact_in(store, "model_g0000.txt")["sha256"] \
+        == manifest["sha256"]
+    got = latest_manifest_in(store)
+    assert got is not None and got[0] == "model_g0000.txt"
+    assert got[1]["canary"]["tol"] == 1e-3
+    # store targets report member NAMES; dir targets joined paths
+    assert latest_manifest(store)[0] == "model_g0000.txt"
+
+
+def test_store_outage_publish_retries_to_success(binary_model,
+                                                 monkeypatch):
+    """store_outage@G: the transport is down for the first attempt;
+    the jittered-backoff retry carries the publication through and the
+    outage is a telemetry event, never a crash."""
+    bst, _, _ = binary_model
+    from lightgbm_tpu.resilience.faults import FAULT_EVENTS, drain_events
+    monkeypatch.setenv("LIGHTGBM_TPU_FAULT_INJECT", "store_outage@4")
+    drain_events(FAULT_EVENTS)
+    store = ObjectStore(MemoryBackend(), url="object://outage")
+    sleeps = []
+    manifest = publish_model(bst, store, "model_g0004.txt",
+                             fault_iteration=4, backoff_base_sec=0.01,
+                             _sleep=sleeps.append)
+    assert len(sleeps) == 1 and sleeps[0] > 0
+    assert validate_artifact_in(store, "model_g0004.txt")["sha256"] \
+        == manifest["sha256"]
+    events = drain_events(FAULT_EVENTS)
+    assert any(e["kind"] == "store_outage" and e["action"] == "retry"
+               for e in events)
+
+
+def test_real_store_outage_also_retries(binary_model):
+    """Not just the injected kind: a StoreError raised by the backend
+    itself rides the same retry loop."""
+    bst, _, _ = binary_model
+    backend = MemoryBackend()
+    store = ObjectStore(backend, url="object://flaky")
+    backend.set_outage(1)
+    manifest = publish_model(bst, store, "m.txt",
+                             backoff_base_sec=0.001,
+                             _sleep=lambda _: None)
+    assert validate_artifact_in(store, "m.txt")["sha256"] \
+        == manifest["sha256"]
+    # exhaustion raises PublishError, never StoreError
+    backend.set_outage(-1)
+    with pytest.raises(PublishError, match="failed after"):
+        publish_model(bst, store, "m2.txt", retries=1,
+                      backoff_base_sec=0.001, _sleep=lambda _: None)
+    backend.set_outage(0)
+
+
+def test_publish_poison_is_byte_valid_but_canary_garbage(
+        binary_model, monkeypatch):
+    """publish_poison@G: the publication's sha256 validates (only the
+    serve-side canary gate can catch it) but its embedded expectations
+    are shifted far outside any tolerance."""
+    bst, X, _ = binary_model
+    from lightgbm_tpu.resilience.faults import FAULT_EVENTS, drain_events
+    monkeypatch.setenv("LIGHTGBM_TPU_FAULT_INJECT", "publish_poison@2")
+    drain_events(FAULT_EVENTS)
+    store = ObjectStore(MemoryBackend(), url="object://poison")
+    canary = _canary_for(bst, X)
+    manifest = publish_model(bst, store, "model_g0002.txt",
+                             canary=canary, fault_iteration=2)
+    # byte-valid: manifest validation accepts the poisoned publication
+    assert validate_artifact_in(store, "model_g0002.txt")["sha256"] \
+        == manifest["sha256"]
+    want = np.asarray(canary["scores"])
+    got = np.asarray(manifest["canary"]["scores"])
+    assert np.all(np.abs(got - want) > 100.0)
+    events = drain_events(FAULT_EVENTS)
+    assert any(e["kind"] == "publish_poison"
+               and e["action"] == "published_poisoned" for e in events)
+
+
+def test_prune_publications_keep_and_protect(tmp_path):
+    names = [f"model_g{g:04d}.txt" for g in range(4)]
+    shas = []
+    for g, name in enumerate(names):
+        # distinct payloads: retention ranks by recency but protects
+        # by sha, so every publication needs its own
+        shas.append(publish_model(f"model body {g}\n", str(tmp_path),
+                                  name, metadata={"v": name})["sha256"])
+        time.sleep(0.02)             # distinct created_unix ordering
+    # keep=0 is unbounded
+    assert prune_publications(str(tmp_path), 0) == []
+    # keep=2 prunes the two oldest — unless protected: g0 survives as
+    # the (say) currently-served model, so only g1 goes
+    pruned = prune_publications(str(tmp_path), 2,
+                                protect_shas=(shas[0],))
+    assert pruned == [names[1]]
+    left = sorted(os.listdir(tmp_path))
+    assert names[1] not in left
+    assert names[1] + MANIFEST_SUFFIX not in left
+    for keepname in (names[0], names[2], names[3]):
+        assert keepname in left and keepname + MANIFEST_SUFFIX in left
+    # newest-validated lookup still lands on g3
+    assert latest_manifest(str(tmp_path))[1]["v"] == names[3]
+
+
+def test_publish_with_keep_prunes_inline(tmp_path):
+    """publish_model(keep=N) prunes after a successful publish, never
+    pruning its own fresh publication."""
+    for g in range(3):
+        publish_model(f"model body {g}\n", str(tmp_path),
+                      f"model_g{g:04d}.txt", keep=2)
+        time.sleep(0.02)
+    left = sorted(n for n in os.listdir(tmp_path)
+                  if not n.endswith(MANIFEST_SUFFIX))
+    assert left == ["model_g0001.txt", "model_g0002.txt"]
+
+
+def test_rollback_publication_republishes_last_known_good(
+        binary_model, tmp_path):
+    bst, X, y = binary_model
+    good = publish_model(bst, str(tmp_path), "model_g0001.txt",
+                         metadata={"generation": 1},
+                         canary=_canary_for(bst, X))
+    time.sleep(0.02)
+    bad_bst = _train({"objective": "binary", "num_leaves": 15},
+                     X, (X[:, 0] > 0).astype(np.float64))
+    bad = publish_model(bad_bst, str(tmp_path), "model_g0002.txt",
+                        metadata={"generation": 2})
+    time.sleep(0.02)
+    manifest = rollback_publication(str(tmp_path), "model_g0002.txt",
+                                    "model_g0001.txt")
+    # the bad publication is GONE (artifact and manifest)
+    left = os.listdir(tmp_path)
+    assert "model_g0002.txt" not in left
+    assert "model_g0002.txt" + MANIFEST_SUFFIX not in left
+    # the republication carries the good bytes (same sha), provenance,
+    # and the good canary — and wins newest-validated polling
+    assert manifest["sha256"] == good["sha256"]
+    assert manifest["rollback_of"] == bad["sha256"]
+    assert manifest["generation"] == 1
+    assert manifest["canary"] == good["canary"]
+    newest_path, newest = latest_manifest(str(tmp_path))
+    assert os.path.basename(newest_path).startswith("rollback_")
+    assert newest["sha256"] == good["sha256"]
+
+
+# ---------------------------------------------------------------------
+# 3. autoscaling + rollback policy
+# ---------------------------------------------------------------------
+
+def _rows(qps_each, n=1, p99=10.0, shed=None):
+    return [{"rank": r, "alive": True, "qps": qps_each, "p99_ms": p99,
+             **({} if shed is None else {"shed_total": shed})}
+            for r in range(n)]
+
+
+def test_autoscale_up_signals_and_observation_consume():
+    clock = [100.0]
+    pol = AutoscalePolicy(1, 3, up_qps=10.0, down_qps=5.0,
+                          up_p99_ms=200.0, up_cooldown_sec=5.0,
+                          down_cooldown_sec=15.0,
+                          _now=lambda: clock[0])
+    # no observation yet -> no decision
+    assert pol.decide(1) is None
+    pol.observe(_rows(25.0))
+    action, reason = pol.decide(1)
+    assert action == "up" and "qps" in reason
+    # the observation is CONSUMED: a tight supervision loop cannot
+    # re-fire on the same scrape
+    assert pol.decide(2) is None
+    # p99 breach scales up too (after the up cooldown)
+    clock[0] += 6.0
+    pol.observe(_rows(1.0, n=2, p99=500.0))
+    assert pol.decide(2)[0] == "up"
+    # shed forward-motion scales up; a restarted replica's counter
+    # RESET does not
+    clock[0] += 6.0
+    pol.observe(_rows(1.0, n=3, shed=50))
+    assert pol.decide(3) is None             # at max_replicas: bounded
+    clock[0] += 6.0
+    pol.observe(_rows(1.0, n=2, shed=80))    # +30 forward
+    assert pol.decide(2)[0] == "up"
+    clock[0] += 6.0
+    pol.observe(_rows(1.0, n=2, shed=0))     # reset, not a shed burst
+    assert pol.decide(2) is None
+    assert pol.scale_ups == 3
+
+
+def test_autoscale_down_hysteresis_and_cooldown():
+    clock = [0.0]
+    pol = AutoscalePolicy(1, 3, up_qps=10.0, down_qps=5.0,
+                          up_p99_ms=200.0, up_cooldown_sec=5.0,
+                          down_cooldown_sec=15.0,
+                          _now=lambda: clock[0])
+    pol.observe(_rows(20.0))
+    assert pol.decide(1)[0] == "up"          # scaled at t=0
+    # calm traffic, but inside the down cooldown: hold
+    clock[0] = 10.0
+    pol.observe(_rows(1.0, n=2))
+    assert pol.decide(2) is None
+    # past the cooldown AND qps clears down_qps with one fewer replica
+    clock[0] = 16.0
+    pol.observe(_rows(1.0, n=2))
+    action, reason = pol.decide(2)
+    assert action == "down" and "qps" in reason
+    # at the floor: never below min_replicas
+    clock[0] = 40.0
+    pol.observe(_rows(0.0))
+    assert pol.decide(1) is None
+    # qps in the dead band (above down threshold, below up): hold —
+    # the hysteresis gap that prevents flapping
+    clock[0] = 60.0
+    pol.observe(_rows(4.0, n=2))             # 8 total; (2-1)*5=5 < 8
+    assert pol.decide(2) is None
+    assert (pol.scale_ups, pol.scale_downs) == (1, 1)
+
+
+def test_rollback_guard_adopts_then_condemns():
+    clock = [0.0]
+    guard = RollbackGuard(refuse_sec=5.0, adopt_sec=2.0,
+                          _now=lambda: clock[0])
+    # publication 1: served -> adopted as last-known-good
+    assert guard.note_publication("model_g0001.txt", "sha1")
+    assert not guard.note_publication("model_g0001.txt", "sha1")
+    guard.observe([{"rank": 0, "sha256": "sha1",
+                    "swap_failures_total": 0}])
+    assert guard.decide() is None            # first sighting starts clock
+    clock[0] = 3.0
+    assert guard.decide() is None
+    assert guard.last_known_good == ("model_g0001.txt", "sha1")
+    # publication 2: nobody serves it and swap failures mount (every
+    # canary gate refused it) -> condemned after refuse_sec
+    assert guard.note_publication("model_g0002.txt", "sha2")
+    guard.observe([{"rank": 0, "sha256": "sha1",
+                    "swap_failures_total": 2}])
+    clock[0] = 4.0
+    assert guard.decide() is None            # refuse_sec not reached
+    clock[0] = 9.0
+    order = guard.decide()
+    assert order == {"bad_name": "model_g0002.txt", "bad_sha": "sha2",
+                     "good_name": "model_g0001.txt",
+                     "good_sha": "sha1"}
+    # condemned shas are remembered: a rollback can never loop
+    assert not guard.note_publication("model_g0002.txt", "sha2")
+    assert guard.decide() is None
+
+
+def test_rollback_guard_requires_swap_failures():
+    """A publication nobody has swapped onto yet but with NO swap
+    failures is still rolling out (slow compile, mid-restart) — the
+    guard must not condemn it on a timer alone."""
+    clock = [0.0]
+    guard = RollbackGuard(refuse_sec=5.0, adopt_sec=2.0,
+                          _now=lambda: clock[0])
+    guard.note_publication("m.txt", "shaX")
+    guard.observe([{"rank": 0, "sha256": "old",
+                    "swap_failures_total": 0}])
+    clock[0] = 60.0
+    assert guard.decide() is None
+    # ...until failures mount
+    guard.observe([{"rank": 0, "sha256": "old",
+                    "swap_failures_total": 3}])
+    assert guard.decide()["bad_sha"] == "shaX"
+
+
+def test_rollback_guard_post_swap_eviction_condemns():
+    """The OTHER rollback trigger: a replica swapped onto the watched
+    publication, then failed post-swap health checks and was evicted
+    — condemned immediately, before any adopt."""
+    clock = [0.0]
+    guard = RollbackGuard(refuse_sec=5.0, adopt_sec=2.0,
+                          _now=lambda: clock[0])
+    guard.note_publication("good.txt", "g")
+    guard.observe([{"rank": 0, "sha256": "g",
+                    "swap_failures_total": 0}])
+    guard.decide()                           # first sighting at t=0
+    clock[0] = 3.0
+    guard.decide()                           # adopted
+    guard.note_publication("next.txt", "n")
+    guard.observe([{"rank": 1, "sha256": "n",
+                    "swap_failures_total": 0}])
+    guard.note_eviction(1)
+    order = guard.decide()
+    assert order["bad_sha"] == "n" and order["good_sha"] == "g"
+
+
+# ---------------------------------------------------------------------
+# 4. the serve-side canary gate
+# ---------------------------------------------------------------------
+
+def test_canary_gate_refuses_poison_then_accepts_valid(
+        binary_model, tmp_path):
+    """A byte-valid publication whose canary scores mismatch is
+    refused BEFORE swap_deferred — the old model keeps serving, a
+    canary_refused fault event fires once, and the swap-failure
+    counter feeds the supervisor's rollback guard. A publication with
+    honest expectations swaps, and the forest installed is the one
+    that scored the canary."""
+    from lightgbm_tpu.resilience.faults import FAULT_EVENTS, drain_events
+    from lightgbm_tpu.serve.batcher import MicroBatcher
+    from lightgbm_tpu.serve.compile import compile_forest
+    from lightgbm_tpu.serve.daemon import (ServeState, _artifact_key,
+                                           _Watcher)
+    bst, X, y = binary_model
+    model_a = str(tmp_path / "a.txt")
+    bst.save_model(model_a)
+    cf = compile_forest(bst, max_batch_rows=256)
+    mb = MicroBatcher(cf, batch_window_ms=0.5, max_batch_rows=256)
+    state = ServeState(mb, cf.model_id, model_a)
+    drain_events(FAULT_EVENTS)
+    try:
+        watcher = _Watcher(
+            state, str(tmp_path), 0.1,
+            dict(num_iteration=-1, min_bucket=16, max_batch_rows=256),
+            _artifact_key(model_a), 64)
+        bst_b = _train({"objective": "binary", "num_leaves": 15},
+                       X, (X[:, 1] > 0).astype(np.float64))
+        poisoned = _canary_for(bst_b, X)
+        poisoned["scores"] = [s + 1e3 for s in poisoned["scores"]]
+        publish_model(bst_b, str(tmp_path), "b.txt", canary=poisoned)
+        target = str(tmp_path / "b.txt")
+        os.utime(target, (time.time() + 2, time.time() + 2))
+
+        assert watcher.poll_once() is False
+        assert state.stats()["swap_failures"] == 1
+        events = drain_events(FAULT_EVENTS)
+        assert any(e["kind"] == "canary_refused"
+                   and e["action"] == "refused_swap" for e in events)
+        assert any(e["kind"] == "swap_failure" for e in events)
+        # the old model is untouched
+        assert state.stats()["model"] == cf.model_id
+        # still refused next poll (counter moves; event fired once)
+        assert watcher.poll_once() is False
+        assert state.stats()["swap_failures"] == 2
+        assert not any(e["kind"] == "canary_refused"
+                       for e in drain_events(FAULT_EVENTS))
+
+        # an honest republication swaps
+        manifest = publish_model(bst_b, str(tmp_path), "b.txt",
+                                 canary=_canary_for(bst_b, X))
+        os.utime(target, (time.time() + 4, time.time() + 4))
+        assert watcher.poll_once() is True
+        st = state.stats()
+        assert st["model"] == compile_forest(bst_b).model_id
+        assert st["manifest"]["sha256"] == manifest["sha256"]
+    finally:
+        state.close()
+
+
+def test_watcher_degrades_through_store_outage(binary_model, tmp_path):
+    """A store outage while polling the watch target degrades to
+    serving the current model with ONE store_outage fault event per
+    episode — never a watcher crash — and recovers when the store
+    does."""
+    from lightgbm_tpu.resilience.faults import FAULT_EVENTS, drain_events
+    from lightgbm_tpu.serve.batcher import MicroBatcher
+    from lightgbm_tpu.serve.compile import compile_forest
+    from lightgbm_tpu.serve.daemon import ServeState, _Watcher
+    bst, X, y = binary_model
+    backend = MemoryBackend()
+    store = ObjectStore(backend, url="object://watch")
+    cf = compile_forest(bst, max_batch_rows=256)
+    mb = MicroBatcher(cf, batch_window_ms=0.5, max_batch_rows=256)
+    state = ServeState(mb, cf.model_id, "seed")
+    drain_events(FAULT_EVENTS)
+    try:
+        watcher = _Watcher(
+            state, store, 0.1,
+            dict(num_iteration=-1, min_bucket=16, max_batch_rows=256),
+            None, 64)
+        backend.set_outage(-1)
+        assert watcher.poll_once() is False
+        assert watcher.poll_once() is False
+        events = drain_events(FAULT_EVENTS)
+        assert sum(1 for e in events
+                   if e["kind"] == "store_outage"
+                   and e["action"] == "degraded") == 1
+        assert state.stats()["model"] == cf.model_id
+        # store recovers -> the next poll swaps onto the publication
+        backend.set_outage(0)
+        bst_b = _train({"objective": "binary", "num_leaves": 15},
+                       X, (X[:, 1] > 0).astype(np.float64))
+        manifest = publish_model(bst_b, store, "b.txt",
+                                 canary=_canary_for(bst_b, X))
+        assert watcher.poll_once() is True
+        assert state.stats()["manifest"]["sha256"] == \
+            manifest["sha256"]
+    finally:
+        state.close()
+
+
+# ---------------------------------------------------------------------
+# 5. drain + scrape robustness
+# ---------------------------------------------------------------------
+
+def _read_ready(proc, tries=400):
+    for _ in range(tries):
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError("daemon exited before serve_ready")
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if obj.get("event") == "serve_ready":
+            return obj
+    raise AssertionError("no serve_ready line")
+
+
+@pytest.mark.slow
+def test_backlogged_connection_gets_draining_reply(binary_model,
+                                                   tmp_path):
+    """The accept-backlog drain regression: a connection that lands in
+    the TCP backlog while the daemon is busy and is only accepted
+    AFTER SIGTERM must get a typed {"error": "draining"} reply, not a
+    hang or a reset. SIGSTOP parks the accept loop so the kernel
+    completes our handshake into the backlog; SIGCONT + the drain
+    window's linger then sweeps it."""
+    bst, X, _ = binary_model
+    model = str(tmp_path / "model.txt")
+    bst.save_model(model)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu", "serve", model,
+         "--port", "0", "--warmup-rows", "64",
+         "--window-ms", "5", "--max-batch-rows", "256",
+         "--grace", "10"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO_DIR, start_new_session=True)
+    try:
+        ready = _read_ready(proc)
+        port = ready["port"]
+        # warm check: the daemon answers (also proves accept works
+        # BEFORE the stop)
+        s0 = socket.create_connection(("127.0.0.1", port), timeout=10)
+        fh0 = s0.makefile("rw")
+        fh0.write(json.dumps({"cmd": "ping"}) + "\n")
+        fh0.flush()
+        assert json.loads(fh0.readline())["ok"]
+        s0.close()
+        os.kill(proc.pid, signal.SIGSTOP)    # accept loop frozen
+        try:
+            # this handshake completes in the KERNEL's listen backlog;
+            # the stopped daemon never accepts it
+            s1 = socket.create_connection(("127.0.0.1", port),
+                                          timeout=10)
+            s1.settimeout(30)
+            fh1 = s1.makefile("rw")
+            os.kill(proc.pid, signal.SIGTERM)   # queued behind STOP
+        finally:
+            os.kill(proc.pid, signal.SIGCONT)
+        # wait until the drain has provably begun (cmd verbs keep
+        # answering during a drain; only predict requests flip) so the
+        # backlogged request cannot race the drain flag
+        deadline = time.monotonic() + 8.0
+        while True:
+            assert time.monotonic() < deadline, "drain never began"
+            try:
+                s2 = socket.create_connection(("127.0.0.1", port),
+                                              timeout=5)
+                fh2 = s2.makefile("rw")
+                fh2.write(json.dumps({"cmd": "stats"}) + "\n")
+                fh2.flush()
+                st = json.loads(fh2.readline())
+                s2.close()
+                if st.get("draining"):
+                    break
+            except (OSError, ValueError):
+                pass
+            time.sleep(0.1)
+        # the drain sweeps the backlog: a typed reply, not a hang
+        fh1.write(json.dumps({"rows": X[:4].tolist()}) + "\n")
+        fh1.flush()
+        line = fh1.readline()
+        assert line, "backlogged connection dropped without a reply"
+        reply = json.loads(line)
+        assert reply.get("error") == "draining", reply
+        assert reply.get("draining") is True
+        s1.close()
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            kill_group(proc)
+
+
+class _FakeProc:
+    def poll(self):
+        return None
+
+
+def _bind_two_ports():
+    """Two CONTIGUOUS free ports (the fleet scrape addresses replicas
+    at health_port + rank)."""
+    for _ in range(50):
+        s0 = socket.socket()
+        try:
+            s0.bind(("127.0.0.1", 0))
+            base = s0.getsockname()[1]
+            s1 = socket.socket()
+            try:
+                s1.bind(("127.0.0.1", base + 1))
+                return s0, s1, base
+            except OSError:
+                s1.close()
+        except OSError:
+            pass
+        s0.close()
+    raise AssertionError("could not find two contiguous free ports")
+
+
+def test_wedged_replica_fails_scrape_without_stalling_round():
+    """A wedged replica — accepts TCP, never replies — must be marked
+    alive: false within one bounded health_timeout, while the healthy
+    replica's row (scraped concurrently) still lands in the SAME
+    round."""
+    from lightgbm_tpu.obs.export import (counter_family, gauge_family,
+                                         render_openmetrics)
+    from lightgbm_tpu.resilience.elastic import _Replica, _scrape_fleet
+    ls0, ls1, base = _bind_two_ports()
+    stop = threading.Event()
+    metrics_text = render_openmetrics({}, extra={
+        "serve_qps": gauge_family(12.5),
+        "serve_p99_ms": gauge_family(8.0),
+        "serve_requests_total": counter_family(100),
+        "serve_shed_total": counter_family(0),
+        "serve_model_info": gauge_family(1, model="m1",
+                                         sha="abc123"),
+    })
+
+    def _healthy():
+        ls0.listen(8)
+        ls0.settimeout(0.2)
+        while not stop.is_set():
+            try:
+                conn, _ = ls0.accept()
+            except socket.timeout:
+                continue
+            conn.recv(65536)
+            conn.sendall((json.dumps(
+                {"ok": True, "metrics": metrics_text}) + "\n"
+            ).encode())
+            conn.close()
+
+    def _wedged():
+        ls1.listen(8)
+        ls1.settimeout(0.2)
+        held = []
+        while not stop.is_set():
+            try:
+                conn, _ = ls1.accept()   # accept, never reply
+                held.append(conn)
+            except socket.timeout:
+                continue
+        for c in held:
+            c.close()
+
+    threads = [threading.Thread(target=_healthy, daemon=True),
+               threading.Thread(target=_wedged, daemon=True)]
+    for t in threads:
+        t.start()
+    try:
+        healthy, wedged = _Replica(0), _Replica(1)
+        healthy.proc = wedged.proc = _FakeProc()
+        t0 = time.monotonic()
+        record = _scrape_fleet([healthy, wedged], base,
+                               health_timeout=1.5)
+        elapsed = time.monotonic() - t0
+        rows = {r["rank"]: r for r in record["replicas"]}
+        assert rows[0]["alive"] and rows[0]["qps"] == 12.5
+        assert rows[0]["sha256"] == "abc123"
+        assert rows[1]["alive"] is False
+        assert rows[1]["responsive"] is False
+        # one bounded round: the wedge cost ~one health_timeout, not
+        # one per replica queued behind it
+        assert elapsed < 4.0, elapsed
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        ls0.close()
+        ls1.close()
+
+
+# ---------------------------------------------------------------------
+# 6. the ISSUE 17 chaos e2e
+# ---------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.timeout(900)
+def test_fleet_chaos_end_to_end(tmp_path):
+    """The acceptance run: a load spike scales the fleet up and back
+    down (hysteresis, no client timeouts), a store outage mid-publish
+    is carried by retry/backoff while the old model keeps serving,
+    and a poisoned generation is refused by the canary gate and
+    rolled back to last-known-good by the fleet supervisor — all
+    confirmed from the merged telemetry."""
+    workdir = str(tmp_path / "pipe")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("LIGHTGBM_TPU_FAULT_INJECT",
+                        "LIGHTGBM_TPU_CHECKPOINT",
+                        "LIGHTGBM_TPU_TELEMETRY")}
+    env["PYTHONPATH"] = REPO_DIR
+    # store_outage@1 downs the transport for generation 1's first
+    # publish attempt; publish_poison@2 poisons generation 2's canary
+    env["LIGHTGBM_TPU_FAULT_INJECT"] = "store_outage@1,publish_poison@2"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "lightgbm_tpu", "pipeline",
+         "--workdir", workdir, "--generations", "3",
+         "--rounds", "5", "--rows", "900", "--features", "8",
+         "--request-rate", "8", "--request-rows", "4",
+         "--replicas", "1", "--max-replicas", "3",
+         "--autoscale-up-qps", "15", "--autoscale-down-qps", "6",
+         "--spike-rate", "60", "--spike-start", "4",
+         "--spike-duration", "12",
+         "--retire-grace", "15", "--rollback-grace", "8",
+         "--canary-rows", "8", "--publish-keep", "4",
+         "--health-interval", "0.5", "--health-grace", "25",
+         "--scrape-interval", "1",
+         "--swap-timeout", "240", "--grace", "10",
+         "--param", "publish_backoff_sec=2"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=REPO_DIR, start_new_session=True)
+    try:
+        out, _ = proc.communicate(timeout=800)
+    except subprocess.TimeoutExpired:
+        kill_group(proc)
+        out, _ = proc.communicate(timeout=30)
+        pytest.fail(f"pipeline hung; partial output:\n{out[-4000:]}")
+    assert proc.returncode == 0, f"pipeline failed:\n{out[-6000:]}"
+    summary = None
+    for line in out.splitlines():
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if obj.get("event") == "pipeline_summary":
+            summary = obj
+    assert summary is not None, out[-4000:]
+    assert summary["failures"] == []
+    assert summary["generations_published"] == 3
+
+    # --- autoscaling: the spike scaled the fleet up, the calm after
+    # it scaled back down, and clients saw no timeouts throughout
+    lifecycle = summary["fleet_lifecycle"]
+    assert lifecycle["scale_ups"] >= 1, lifecycle
+    assert lifecycle["scale_downs"] >= 1, lifecycle
+    assert lifecycle["replicas_peak"] >= 2, lifecycle
+    client = summary["client"]
+    assert client["timeout"] == 0, client
+    assert client["ok"] > 0
+
+    # --- rollback: generation 2's poisoned publication was refused
+    # and rolled back to generation 1 (same bytes -> same sha)
+    rollbacks = summary["rollbacks"]
+    assert len(rollbacks) == 1, rollbacks
+    assert lifecycle["rollbacks"] == 1
+    # the fleet converged on the rollback republication of gen 1,
+    # never serving the poisoned model
+    poisoned_sha = rollbacks[0]["bad_sha"]
+    good_sha = rollbacks[0]["good_sha"]
+    assert poisoned_sha and good_sha and poisoned_sha != good_sha
+    fleet = summary["fleet"]
+    assert fleet and all(st is not None for st in fleet)
+    for st in fleet:
+        assert st["manifest_sha256"] == good_sha
+        assert st["manifest_sha256"] != poisoned_sha
+
+    # --- the fault/refusal evidence landed in telemetry: the serve
+    # side refused the canary; generation 1's trainer retried through
+    # the store outage
+    telem = os.path.join(workdir, "telemetry")
+    serve_kinds = set()
+    for suffix in ("", ".rank1", ".rank2"):
+        path = os.path.join(telem, "serve.jsonl" + suffix)
+        if not os.path.exists(path):
+            continue
+        with open(path) as fh:
+            for ln in fh:
+                if not ln.strip():
+                    continue
+                ev = json.loads(ln)
+                if ev.get("event") == "fault":
+                    serve_kinds.add(ev.get("kind"))
+    assert "canary_refused" in serve_kinds, serve_kinds
+    train_kinds = set()
+    with open(os.path.join(telem, "train_g0001.jsonl")) as fh:
+        for ln in fh:
+            if not ln.strip():
+                continue
+            ev = json.loads(ln)
+            if ev.get("event") == "fault":
+                train_kinds.add(ev.get("kind"))
+    assert "store_outage" in train_kinds, train_kinds
+
+    # --- `stats --fleet` merges the autoscale/rollback evidence
+    st = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", "stats", telem,
+         "--fleet"],
+        env=env, capture_output=True, text=True, timeout=120,
+        cwd=REPO_DIR)
+    assert st.returncode == 0, st.stderr[-3000:]
+    assert "autoscale" in st.stdout, st.stdout
+    assert "rollbacks" in st.stdout, st.stdout

@@ -185,6 +185,7 @@ _FIXTURES = [
     "obs/tpl008_export_pos.py", "obs/tpl008_export_neg.py",
     "obs/tpl008_trace_pos.py", "obs/tpl008_trace_neg.py",
     "serve/tpl008_pos.py", "serve/tpl008_neg.py",
+    "resilience/tpl008_pos.py", "resilience/tpl008_neg.py",
     "pipeline/tpl006_pos.py", "pipeline/tpl006_neg.py",
     "pipeline/tpl008_pos.py", "pipeline/tpl008_neg.py",
     "tpl009_pos.py", "tpl009_neg.py",
@@ -681,13 +682,51 @@ def test_metrics_plane_is_thread_and_lock_clean():
 
 
 def test_pipeline_and_publisher_are_thread_clean():
-    """The shipped lifecycle modules (pipeline.py, the publisher under
-    resilience/) lint clean for the thread/lock rules."""
+    """The shipped lifecycle modules (pipeline.py, the publisher /
+    store / autoscaler under resilience/) lint clean for the
+    thread/lock rules."""
     res = run_lint(root=PKG, rules=["TPL006", "TPL008"],
                    baseline_path=BASELINE,
                    files=["pipeline.py", "resilience/publisher.py",
-                          "resilience/elastic.py"])
+                          "resilience/elastic.py",
+                          "resilience/store.py",
+                          "resilience/autoscale.py"])
     assert not res.findings, [f.fid for f in res.findings]
+
+
+def test_stripping_the_autoscaler_lock_fails(tmp_path):
+    """Self-healing-fleet acceptance mutation (ISSUE 17): strip the
+    lock from the autoscaling policy's scrape-side ingest
+    (resilience/autoscale.py AutoscalePolicy.observe) -> TPL008 names
+    the shared observation fields decide() consumes on the supervision
+    loop. The mutated copy is linted TOGETHER with the unmodified
+    fleet supervisor, whose scrape thread puts observe() on the
+    thread side of the call graph."""
+    import shutil
+    anchor = ("        with self._lock:\n"
+              "            shed_delta = 0.0\n")
+    with open(os.path.join(PKG, "resilience", "autoscale.py"),
+              encoding="utf-8") as fh:
+        src = fh.read()
+    mutated = src.replace(
+        anchor, "        if True:\n            shed_delta = 0.0\n")
+    assert mutated != src, "mutation did not apply to autoscale.py"
+    for rel in ("resilience/elastic.py",):
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(os.path.join(PKG, rel), dst)
+    dst = tmp_path / "resilience" / "autoscale.py"
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_text(mutated, encoding="utf-8")
+    res = run_lint(root=str(tmp_path), package="lightgbm_tpu",
+                   files=["resilience/autoscale.py",
+                          "resilience/elastic.py"],
+                   baseline_path="", rules=["TPL008"])
+    fids = [f.fid for f in res.findings]
+    assert ("TPL008:resilience/autoscale.py:AutoscalePolicy.observe:"
+            "shared:self._shed_delta#1" in fids), fids
+    assert ("TPL008:resilience/autoscale.py:AutoscalePolicy.observe:"
+            "shared:self._seq#1" in fids), fids
 
 
 def test_grow_collective_conds_are_justified():
