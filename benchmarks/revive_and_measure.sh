@@ -24,11 +24,23 @@
 #       queue/batch/dispatch stage decomposition in the same line)
 # Each step is individually time-bounded so a mid-battery tunnel death
 # still leaves earlier results on disk.
+# Step 0 (before any tunnel probing): lint --ir --strict on CPU. The
+# battery burns hours of scarce TPU time — don't spend them measuring
+# a tree whose lowered programs already violate a committed contract
+# (dtype widening, collective-budget regression, dead donation,
+# undeclared recompile surface).
 cd "$(dirname "$0")/.." || exit 1
 PROBE_INTERVAL=${PROBE_INTERVAL:-120}
 MAX_WAIT=${MAX_WAIT:-39600}   # give up after 11 h
 start=$(date +%s)
 log() { echo "[revive $(date +%H:%M:%S)] $*"; }
+
+log "step 0: lint --ir --strict (CPU, IR contracts gate the battery)"
+if ! JAX_PLATFORMS=cpu timeout 300 \
+        python -m lightgbm_tpu lint --ir --strict; then
+    log "lint --ir FAILED - fix the IR contracts before burning TPU time"
+    exit 3
+fi
 
 while :; do
     if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then

@@ -24,7 +24,14 @@ invariants at review time, from the source alone:
   :mod:`~lightgbm_tpu.analysis.rules_flow`; see
   docs/STATIC_ANALYSIS.md),
 - :mod:`~lightgbm_tpu.analysis.baseline` matches findings against the
-  checked-in accepted-findings file (tools/tpulint_baseline.txt).
+  checked-in accepted-findings file (tools/tpulint_baseline.txt),
+- :mod:`~lightgbm_tpu.analysis.ircheck` (``lint --ir`` only — the one
+  lint mode that imports jax, CPU lowering only, never executing)
+  lowers every ``register_jit`` entry point at its declared
+  signatures and checks the IR contracts TPL011-TPL014: dtype
+  contract, collective bytes vs the committed tools/ir_budgets.json,
+  donation honored in the lowered program, recompile surface
+  declared.
 
 Entry points: ``python -m lightgbm_tpu lint`` (see
 :mod:`~lightgbm_tpu.analysis.cli`), :func:`run_lint` for library use,
@@ -34,10 +41,10 @@ tree.
 
 from .callgraph import CallGraph, build_callgraph
 from .engine import LintResult, default_scope, package_root, run_lint
-from .rules import ALL_RULES, Finding, rule_by_id
+from .rules import ALL_RULES, IR_RULES, Finding, rule_by_id
 
 __all__ = [
     "run_lint", "LintResult", "build_callgraph", "CallGraph",
-    "Finding", "ALL_RULES", "rule_by_id", "default_scope",
+    "Finding", "ALL_RULES", "IR_RULES", "rule_by_id", "default_scope",
     "package_root",
 ]

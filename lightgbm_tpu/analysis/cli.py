@@ -37,7 +37,12 @@ def build_parser() -> argparse.ArgumentParser:
             "locks across dispatch, rank-divergent collective order, "
             "thread-shared-state races, float64 promotion leaks, "
             "device collectives under traced conditionals). "
-            "See docs/STATIC_ANALYSIS.md."),
+            "With --ir it additionally lowers every register_jit "
+            "entry point on CPU (never executing) and checks the IR "
+            "contracts TPL011-TPL014 (strong float64 in the jaxpr, "
+            "collective bytes vs tools/ir_budgets.json, donation "
+            "honored in the lowered program, recompile surface "
+            "declared). See docs/STATIC_ANALYSIS.md."),
         epilog=EXIT_CODES,
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("--format", choices=("text", "json", "sarif"),
@@ -57,7 +62,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rule", metavar="TPLNNN", action="append",
                    default=None,
                    help="run only this rule (repeatable); default: "
-                        "TPL001-TPL010")
+                        "TPL001-TPL010 (TPL011-TPL014 also need "
+                        "--ir)")
+    p.add_argument("--ir", action="store_true",
+                   help="also lower every register_jit entry point "
+                        "at its declared signatures and run the IR "
+                        "rules TPL011-TPL014; the only lint mode "
+                        "that imports jax (CPU, lowering only)")
+    p.add_argument("--ir-entry", metavar="NAME", action="append",
+                   default=None,
+                   help="with --ir: lower only this entry point "
+                        "(repeatable; 'parallel/dp_grow' or "
+                        "'parallel/dp_grow@wide-sharded')")
     p.add_argument("--root", metavar="DIR", default=None,
                    help="package directory to analyze (default: the "
                         "installed lightgbm_tpu package)")
@@ -122,6 +138,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("tpulint: error: --write-baseline requires a full run "
               "(drop --changed)", file=sys.stderr)
         return 2
+    if args.ir_entry and not args.ir:
+        print("tpulint: error: --ir-entry requires --ir",
+              file=sys.stderr)
+        return 2
+    ir_rule_ids = {"TPL011", "TPL012", "TPL013", "TPL014"}
+    if args.rule and not args.ir and ir_rule_ids & set(args.rule):
+        # keep the contract explicit: the jax import only ever
+        # happens under --ir, never because a rule id implied it
+        print(f"tpulint: error: "
+              f"{', '.join(sorted(ir_rule_ids & set(args.rule)))} "
+              f"are IR rules — add --ir", file=sys.stderr)
+        return 2
     from .engine import default_scope, package_root, run_lint
     scope = None
     if args.changed is not None:
@@ -140,7 +168,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             return 0
     try:
         result = run_lint(root=args.root, rules=args.rule,
-                          baseline_path=args.baseline, scope=scope)
+                          baseline_path=args.baseline, scope=scope,
+                          ir=args.ir, ir_entries=args.ir_entry)
     except (ValueError, OSError, SyntaxError) as e:
         print(f"tpulint: error: {e}", file=sys.stderr)
         return 2
@@ -161,7 +190,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if result.findings:
         return 1
     if args.strict and (result.stale_baseline
-                        or result.unjustified_baseline):
+                        or result.unjustified_baseline
+                        or result.stale_budget
+                        or result.unjustified_budget):
         return 1
     return 0
 

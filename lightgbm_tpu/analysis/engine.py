@@ -67,6 +67,13 @@ class LintResult:
     elapsed: float
     unjustified_baseline: List[BaselineEntry] = field(
         default_factory=list)
+    # --ir only: budget-file discipline (tools/ir_budgets.json keys
+    # that no spec lowers anymore / that lack a real justification)
+    # and the entry points the IR pass actually lowered
+    stale_budget: List[BaselineEntry] = field(default_factory=list)
+    unjustified_budget: List[BaselineEntry] = field(
+        default_factory=list)
+    ir_entries: List[str] = field(default_factory=list)
 
     @property
     def ok(self) -> bool:
@@ -82,7 +89,9 @@ def run_lint(root: Optional[str] = None,
              scope: Optional[Set[str]] = None,
              rules: Optional[Sequence[str]] = None,
              baseline_path: Optional[str] = None,
-             files: Optional[List[str]] = None) -> LintResult:
+             files: Optional[List[str]] = None,
+             ir: bool = False,
+             ir_entries: Optional[Sequence[str]] = None) -> LintResult:
     """Run the analyzer.
 
     Args:
@@ -90,11 +99,18 @@ def run_lint(root: Optional[str] = None,
         ``lightgbm_tpu``). The whole package is always parsed for the
         call graph; ``scope`` limits where rules REPORT.
       scope: relpaths rules run over (default: the hot-path scope).
-      rules: rule ids to run (default: all).
+      rules: rule ids to run (default: all AST rules; the IR rules
+        TPL011-TPL014 additionally require ``ir=True``).
       baseline_path: accepted-findings file ("": no baseline;
         None: tools/tpulint_baseline.txt when present).
       files: restrict parsing to these package-relative files
         (fixture tests use this).
+      ir: also lower every registered entry point and run the IR
+        rules (TPL011-TPL014). This — and ONLY this — imports jax
+        (lazily, pinned to CPU, lowering only); the default path
+        stays pure stdlib.
+      ir_entries: restrict the IR pass to these entry points
+        (``name@variant`` or bare registry name).
     """
     t0 = time.perf_counter()
     root = root or package_root()
@@ -113,9 +129,10 @@ def run_lint(root: Optional[str] = None,
         for rid in rules:
             rule = rule_by_id(rid)
             if rule is None:
+                from .rules import IR_RULES
                 raise ValueError(
                     f"unknown rule {rid!r} (have: "
-                    f"{', '.join(r.id for r in ALL_RULES)})")
+                    f"{', '.join(r.id for r in ALL_RULES + IR_RULES)})")
             wanted.append(rule)
         active = wanted
 
@@ -128,6 +145,27 @@ def run_lint(root: Optional[str] = None,
                 suppressed.append(f)
             else:
                 findings.append(f)
+
+    stale_budget: List[BaselineEntry] = []
+    unjustified_budget: List[BaselineEntry] = []
+    ir_entries_run: List[str] = []
+    ir_ids_run: Set[str] = set()
+    if ir:
+        # lazy on purpose: this is the ONLY place the lint path may
+        # import jax, and only under an explicit --ir
+        from .ircheck import IR_RULE_IDS, run_ircheck
+        ir_rules = [rid for rid in (rules or IR_RULE_IDS)
+                    if rid in IR_RULE_IDS]
+        if ir_rules:
+            ir_result = run_ircheck(rules=ir_rules, entries=ir_entries)
+            findings.extend(ir_result.findings)
+            stale_budget = ir_result.stale_budget
+            unjustified_budget = ir_result.unjustified_budget
+            ir_entries_run = ir_result.entries_run
+            # staleness of baselined IR findings is only decidable
+            # when the full entry table was lowered
+            if not ir_entries:
+                ir_ids_run = set(ir_rules)
     assign_ids(findings + suppressed)
 
     if baseline_path is None:
@@ -148,7 +186,11 @@ def run_lint(root: Optional[str] = None,
     # applies no path filter on purpose: an entry whose file was
     # deleted or renamed must still surface as stale, or --strict
     # would let it rot invisibly forever.
-    active_ids = {r.id for r in active}
+    # IR rules are excluded from the AST active set by construction
+    # (they live in IR_RULES, not ALL_RULES); their baselined entries
+    # only count as stale when the IR pass lowered the full table
+    active_ids = {r.id for r in active
+                  if not getattr(r, "ir_only", False)} | ir_ids_run
 
     def _fid_path(fid: str) -> str:
         parts = fid.split(":", 2)
@@ -157,7 +199,9 @@ def run_lint(root: Optional[str] = None,
     stale = [e for e in entries
              if e.fid not in seen_fids
              and e.fid.split(":", 1)[0] in active_ids
-             and (not narrowed_scope or _fid_path(e.fid) in scope)]
+             and (not narrowed_scope
+                  or _fid_path(e.fid) in scope
+                  or e.fid.split(":", 1)[0] in ir_ids_run)]
     unjustified = [e for e in entries if not e.justification]
     kept.sort(key=lambda f: f.sort_key())
     baselined.sort(key=lambda f: f.sort_key())
@@ -165,4 +209,7 @@ def run_lint(root: Optional[str] = None,
                       stale_baseline=stale, suppressed=suppressed,
                       files=set(relpaths) & scope, graph=graph,
                       elapsed=time.perf_counter() - t0,
-                      unjustified_baseline=unjustified)
+                      unjustified_baseline=unjustified,
+                      stale_budget=stale_budget,
+                      unjustified_budget=unjustified_budget,
+                      ir_entries=ir_entries_run)

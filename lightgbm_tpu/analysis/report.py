@@ -31,15 +31,29 @@ def render_text(result) -> str:
                      "occurs — delete them):")
         for e in result.stale_baseline:
             lines.append(f"    {e.fid}")
+    if getattr(result, "stale_budget", None):
+        lines.append("")
+        lines.append("stale ir_budgets.json entries (no spec lowers "
+                     "this entry point — delete them):")
+        for e in result.stale_budget:
+            lines.append(f"    {e.fid}")
+    if getattr(result, "unjustified_budget", None):
+        lines.append("")
+        lines.append("unjustified ir_budgets.json entries (every "
+                     "budget needs a real justification):")
+        for e in result.unjustified_budget:
+            lines.append(f"    {e.fid}")
     n = len(result.findings)
     b = len(result.baselined)
+    ir = getattr(result, "ir_entries", None)
     lines.append("")
     lines.append(
         f"tpulint: {n} finding{'s' if n != 1 else ''}"
         + (f" ({b} baselined and suppressed)" if b else "")
         + f", {len(result.files)} files, "
-        f"{len(result.graph.jit_reachable)} jit-reachable functions, "
-        f"{result.elapsed:.2f}s")
+        f"{len(result.graph.jit_reachable)} jit-reachable functions"
+        + (f", {len(ir)} IR entries lowered" if ir else "")
+        + f", {result.elapsed:.2f}s")
     return "\n".join(lines)
 
 
@@ -53,6 +67,12 @@ def render_json(result) -> str:
         "findings": [fdict(f) for f in result.findings],
         "baselined": [fdict(f) for f in result.baselined],
         "stale_baseline": [e.fid for e in result.stale_baseline],
+        "stale_budget": [e.fid for e in
+                         getattr(result, "stale_budget", [])],
+        "unjustified_budget": [e.fid for e in
+                               getattr(result, "unjustified_budget",
+                                       [])],
+        "ir_entries": list(getattr(result, "ir_entries", [])),
         "files": sorted(result.files),
         "jit_reachable": sorted(
             f"{p}:{q}" for (p, q) in result.graph.jit_reachable),
@@ -64,7 +84,7 @@ def render_sarif(result) -> str:
     """SARIF 2.1.0 — attachable to code-review tooling. Non-baselined
     findings become ``results``; baselined ones ride along with a
     ``suppressions`` entry so reviewers see the accepted set too."""
-    from .rules import ALL_RULES
+    from .rules import ALL_RULES, IR_RULES
 
     pkg = ""
     for s in result.graph.scans.values():
@@ -83,7 +103,9 @@ def render_sarif(result) -> str:
                                else f.relpath,
                         "uriBaseId": "SRCROOT",
                     },
-                    "region": {"startLine": f.lineno,
+                    # IR findings without a source anchor carry line
+                    # 0; SARIF requires startLine >= 1
+                    "region": {"startLine": max(f.lineno, 1),
                                "startColumn": f.col + 1},
                 },
                 "logicalLocations": [{
@@ -113,7 +135,7 @@ def render_sarif(result) -> str:
                     "id": r.id,
                     "shortDescription": {"text": r.title},
                     "helpUri": "docs/STATIC_ANALYSIS.md",
-                } for r in ALL_RULES],
+                } for r in ALL_RULES + IR_RULES],
             }},
             "results": [_result(f, False) for f in result.findings]
             + [_result(f, True) for f in result.baselined],

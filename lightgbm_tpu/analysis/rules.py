@@ -20,7 +20,8 @@ from typing import Dict, Iterator, List, Optional, Set, Tuple
 from .astscan import ModuleScan, dotted_of
 from .callgraph import CallGraph, CallRecord, Key
 
-__all__ = ["Finding", "Rule", "ALL_RULES", "rule_by_id", "LintContext"]
+__all__ = ["Finding", "Rule", "ALL_RULES", "IR_RULES", "rule_by_id",
+           "LintContext"]
 
 _LAX_LOOPS = {"fori_loop", "scan", "while_loop"}
 
@@ -553,8 +554,57 @@ ALL_RULES: List[Rule] = [EagerLaxLoop(), HostSync(), RecompileHazard(),
                          LockAcrossDispatch(), *FLOW_RULES]
 
 
+# ---------------------------------------------------------------------
+# IR-contract rules (TPL011-TPL014): descriptors only. The checks run
+# in analysis/ircheck.py under ``lint --ir`` — the ONE path that
+# imports jax — by lowering every registered entry point at its
+# declared signatures and diffing the IR against committed budgets.
+# They are deliberately NOT in ALL_RULES: the default AST pass stays
+# jax-free and byte-identical, and the AST fixture-coverage test keeps
+# its exact TPL001-TPL010 surface.
+# ---------------------------------------------------------------------
+
+class IRRule(Rule):
+    """Base for lowered-IR rules. ``run`` never yields — findings come
+    from :mod:`~lightgbm_tpu.analysis.ircheck`."""
+
+    ir_only = True
+
+    def run(self, ctx: LintContext) -> Iterator[Finding]:
+        return iter(())
+
+
+class DtypeContractIR(IRRule):
+    id = "TPL011"
+    title = ("f64 op or weak-type widening in lowered IR "
+             "(traced under enable_x64; weak scalar plumbing exempt)")
+
+
+class CollectiveBudgetIR(IRRule):
+    id = "TPL012"
+    title = ("collective payload exceeds the committed "
+             "tools/ir_budgets.json budget (or has none)")
+
+
+class DonationHonoredIR(IRRule):
+    id = "TPL013"
+    title = ("declared donate_argnums shows no input->output aliasing "
+             "in the lowered program")
+
+
+class RecompileSurfaceIR(IRRule):
+    id = "TPL014"
+    title = ("jit entry point without a declared max_signatures "
+             "recompile surface (or declaration below the pow2 serve "
+             "bucket ladder)")
+
+
+IR_RULES: List[Rule] = [DtypeContractIR(), CollectiveBudgetIR(),
+                        DonationHonoredIR(), RecompileSurfaceIR()]
+
+
 def rule_by_id(rid: str) -> Optional[Rule]:
-    for r in ALL_RULES:
+    for r in ALL_RULES + IR_RULES:
         if r.id == rid:
             return r
     return None

@@ -42,6 +42,23 @@ from .tree import (Tree, pack_tree_device, tree_from_arrays,
 __all__ = ["GBDTBooster", "resolve_hist_method", "resolve_scan_iters"]
 
 
+def _donate(*argnums: int):
+    """Donation argnums for the fused step/scan wrappers.
+
+    On CPU XLA ignores donation and warns per dispatch, so the
+    wrappers normally declare none there — but ``lint --ir`` (TPL013,
+    analysis/ircheck.py) must lower the SAME donation contract the TPU
+    path runs with to verify input→output aliasing on a CPU-only CI
+    host: LIGHTGBM_TPU_FORCE_DONATE=1 keeps the declaration on any
+    backend (lowering only — nothing executes under the lint)."""
+    import os
+
+    if jax.default_backend() == "cpu" \
+            and os.environ.get("LIGHTGBM_TPU_FORCE_DONATE") != "1":
+        return ()
+    return argnums
+
+
 def resolve_scan_iters(requested) -> int:
     """Concrete scan-window budget from ``Config.fused_scan_iters``.
 
@@ -275,10 +292,13 @@ def _linear_eval(const, coef, feats, nfeat, leaf_value, raw, leaves):
 # (obs/cost.py: one {"event": "compile"} record per first compile per
 # signature)
 _tree_values_binned = register_jit("gbdt/tree_values_binned",
-                                   _tree_values_binned)
+                                   _tree_values_binned,
+                                   max_signatures=8)
 _tree_leaves_binned = register_jit("gbdt/tree_leaves_binned",
-                                   _tree_leaves_binned)
-_linear_eval = register_jit("gbdt/linear_eval", _linear_eval)
+                                   _tree_leaves_binned,
+                                   max_signatures=8)
+_linear_eval = register_jit("gbdt/linear_eval", _linear_eval,
+                            max_signatures=8)
 
 
 class _ValidData:
@@ -750,7 +770,7 @@ class GBDTBooster:
             self.interaction_groups is not None,
             self.forced is not None,
             self.grow_cfg.bynode < 1.0,
-            has_bundle=self.bundle is not None))
+            has_bundle=self.bundle is not None), max_signatures=8)
 
     def _init_keys_and_rngs(self, cfg):
         # distinct stream for per-node column sampling (ColSampler's
@@ -1547,9 +1567,10 @@ class GBDTBooster:
 
         # donate the old score buffer (it is consumed) — except on CPU,
         # where XLA ignores donation and warns
-        donate = () if jax.default_backend() == "cpu" else (0,)
-        self._fused_fn = register_jit("gbdt/fused_iter",
-                                      jax.jit(step, donate_argnums=donate))
+        self._fused_fn = register_jit(
+            "gbdt/fused_iter",
+            jax.jit(step, donate_argnums=_donate(0)),
+            max_signatures=4)
         return self._fused_fn
 
     # ------------------------------------------------------------------
@@ -1658,9 +1679,9 @@ class GBDTBooster:
 
         # donate the score AND bagging carries (both are consumed) —
         # except on CPU, where XLA ignores donation and warns
-        donate = () if jax.default_backend() == "cpu" else (0, 1)
         fn = register_jit("gbdt/fused_scan",
-                          jax.jit(scan_fn, donate_argnums=donate))
+                          jax.jit(scan_fn, donate_argnums=_donate(0, 1)),
+                          max_signatures=4)
         self._scan_fns[key] = fn
         return fn
 

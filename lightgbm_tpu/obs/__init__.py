@@ -40,7 +40,8 @@ from .cost import (CostTracked, compile_events_snapshot, device_peaks,
                    drain_compile_events, roofline_optimal_ms)
 from .export import (MetricsHTTPServer, ensure_metrics_server,
                      parse_openmetrics, render_openmetrics)
-from .jit_tracker import (RecompileWatcher, jit_cache_sizes, register_jit,
+from .jit_tracker import (RecompileWatcher, jit_cache_sizes,
+                          jit_declarations, register_jit,
                           total_recompiles)
 from .memory import device_memory_stats
 from .recorder import (ITERATION_EVENT_KEYS, TelemetryRecorder,
@@ -54,7 +55,8 @@ from .trace import (SPAN_EVENT_KEYS, current_context, drain_span_events,
 
 __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram", "registry",
-    "register_jit", "jit_cache_sizes", "total_recompiles",
+    "register_jit", "jit_cache_sizes", "jit_declarations",
+    "total_recompiles",
     "RecompileWatcher", "device_memory_stats",
     "TelemetryRecorder", "ITERATION_EVENT_KEYS",
     "summarize_events", "render_stats_table",

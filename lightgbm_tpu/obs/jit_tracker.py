@@ -37,24 +37,45 @@ import weakref
 from typing import Callable, Dict, Tuple
 
 __all__ = ["register_jit", "jit_cache_sizes", "total_recompiles",
-           "RecompileWatcher"]
+           "jit_declarations", "RecompileWatcher"]
 
 _lock = threading.Lock()
 # (name, seq) -> weakref to the jitted callable; weak so per-booster
 # fused functions don't outlive their engine
 _tracked: Dict[Tuple[str, int], "weakref.ref"] = {}
 _seq = 0
+# name -> declared recompile surface: the number of distinct call
+# signatures the entry point is ALLOWED to compile over a process
+# lifetime (the pow2 serve buckets, the per-(W, bag_live) scan
+# variants, ...). ``lint --ir`` (analysis/ircheck.py, TPL014) demands a
+# declaration at every register_jit site and the telemetry consistency
+# test cross-checks jit_cache_sizes() against it — an entry whose
+# cache outgrows its declaration is a recompile storm by definition.
+_declared: Dict[str, int] = {}
 
 
-def register_jit(name: str, fn: Callable) -> Callable:
+def register_jit(name: str, fn: Callable,
+                 max_signatures: int = None) -> Callable:
     """Track a jitted callable's compile cache and wrap it for XLA
     cost attribution; returns the (wrapped) callable, so definition
     sites rebind: ``fn = register_jit("name", fn)``. Non-jitted
     callables (no ``_cache_size``) are accepted and returned
     unchanged — callers never need to branch. Re-registering the same
     live object (or its already-registered wrapper) under the same
-    name returns the existing wrapper, never a duplicate entry."""
+    name returns the existing wrapper, never a duplicate entry.
+
+    ``max_signatures`` declares the entry point's recompile surface:
+    the maximum number of distinct trace signatures the function is
+    expected to compile. The declaration is advisory at runtime (no
+    enforcement here — a hot path must never raise over telemetry) but
+    is enforced statically by ``lint --ir`` (TPL014) and dynamically by
+    the telemetry consistency test."""
     global _seq
+    if max_signatures is not None:
+        with _lock:
+            prev = _declared.get(name)
+            _declared[name] = max(prev, max_signatures) \
+                if prev is not None else max_signatures
     if not hasattr(fn, "_cache_size"):
         return fn
     from .cost import CostTracked, cost_wrap_enabled
@@ -102,6 +123,14 @@ def jit_cache_sizes() -> Dict[Tuple[str, int], int]:
 def total_recompiles() -> int:
     """Total compilations across all live tracked entry points."""
     return sum(jit_cache_sizes().values())
+
+
+def jit_declarations() -> Dict[str, int]:
+    """Declared recompile surface per entry name (``max_signatures``
+    passed to :func:`register_jit`). Re-registrations keep the largest
+    declaration seen (cv folds / rebuilt fused steps re-declare)."""
+    with _lock:
+        return dict(_declared)
 
 
 class RecompileWatcher:

@@ -1836,7 +1836,9 @@ def _grow_compact_impl(cfg: GrowConfig,
                                         (jnp.int32(0), w_start),
                                         (CK, NWl))
             else:
-                blk = lax.dynamic_slice(w32, (pos0, w_start), (CK, NWl))
+                blk = lax.dynamic_slice(
+                    w32, (pos0, jnp.asarray(w_start, pos0.dtype)),
+                    (CK, NWl))
             return _unpack_words(blk)                     # [CK, Fl]
         blk = _bins_slice(w32, pos0, CK)
         return _unpack_words(blk)[:, :F]
@@ -1846,7 +1848,8 @@ def _grow_compact_impl(cfg: GrowConfig,
         self-concatenation (vectorized; no per-element gather)."""
         if a.ndim == 2:
             return lax.dynamic_slice(jnp.concatenate([a, a], axis=0),
-                                     (s, 0), (a.shape[0], a.shape[1]))
+                                     (s, jnp.zeros((), s.dtype)),
+                                     (a.shape[0], a.shape[1]))
         return lax.dynamic_slice(jnp.concatenate([a, a]), (s,),
                                  (a.shape[0],))
 
@@ -1916,15 +1919,17 @@ def _grow_compact_impl(cfg: GrowConfig,
         if wide_part and w32.ndim == 1:
             return lax.dynamic_slice(
                 w32, (pos0 * NW,), (CK * NW,)).reshape(CK, NW)
-        return lax.dynamic_slice(w32, (pos0, 0), (CK, NW))
+        return lax.dynamic_slice(
+            w32, (pos0, jnp.zeros((), pos0.dtype)), (CK, NW))
 
     def _bins_write(arr, off, block, m):
         """Masked RMW of a [CK, NW] block at row offset ``off``
         (the wide mode addresses the flat buffer)."""
         if not wide_part:
-            cur = lax.dynamic_slice(arr, (off, 0), block.shape)
+            z = jnp.zeros((), off.dtype)
+            cur = lax.dynamic_slice(arr, (off, z), block.shape)
             out = jnp.where(m[:, None], block, cur)
-            return lax.dynamic_update_slice(arr, out, (off, 0))
+            return lax.dynamic_update_slice(arr, out, (off, z))
         CK = block.shape[0]
         cur = lax.dynamic_slice(
             arr, (off * NW,), (CK * NW,)).reshape(CK, NW)
@@ -1939,7 +1944,8 @@ def _grow_compact_impl(cfg: GrowConfig,
         on the MXU. Shared by the post-partition child pass and the
         pool-miss window recompute."""
         blk_b = _local_hist_rows(bins2, pos0, CK)
-        blk_p = lax.dynamic_slice(pay2, (pos0, 0), (CK, C))
+        blk_p = lax.dynamic_slice(
+            pay2, (pos0, jnp.zeros((), pos0.dtype)), (CK, C))
         valid = jnp.arange(CK) < jnp.clip(limit, 0, CK)
         hp = blk_p * valid[:, None].astype(blk_p.dtype)
         if quant:
@@ -1994,10 +2000,11 @@ def _grow_compact_impl(cfg: GrowConfig,
         def write(arr, off, block, m):
             """Masked RMW block write at a dynamic row offset."""
             if arr.ndim == 2:
-                cur = lax.dynamic_slice(arr, (off, 0),
+                z = jnp.zeros((), off.dtype)
+                cur = lax.dynamic_slice(arr, (off, z),
                                         (block.shape[0], arr.shape[1]))
                 out = jnp.where(m[:, None], block, cur)
-                return lax.dynamic_update_slice(arr, out, (off, 0))
+                return lax.dynamic_update_slice(arr, out, (off, z))
             cur = lax.dynamic_slice(arr, (off,), (block.shape[0],))
             out = jnp.where(m, block, cur)
             return lax.dynamic_update_slice(arr, out, (off,))
@@ -2013,19 +2020,20 @@ def _grow_compact_impl(cfg: GrowConfig,
                 off = base_off + c * CK
                 pos0 = src_base + off
                 blk_w = _bins_slice(bins2, pos0, CK)
-                blk_p = lax.dynamic_slice(pay2, (pos0, 0), (CK, C))
+                blk_p = lax.dynamic_slice(
+                    pay2, (pos0, jnp.zeros((), pos0.dtype)), (CK, C))
                 split_col = _extract_col(blk_w,
                                          bundle_of[f] if bundled else f)
                 gl = chunk_goleft(split_col, f, t, dl, isc, cm)
                 valid = iota_c < jnp.clip(cnt - off, 0, CK)
                 vl = valid & gl
-                l_c = jnp.sum(vl.astype(jnp.int32))
-                r_c = jnp.sum((valid & ~gl).astype(jnp.int32))
+                l_c = jnp.sum(vl, dtype=jnp.int32)
+                r_c = jnp.sum(valid & ~gl, dtype=jnp.int32)
                 if track:
                     blk_o = lax.dynamic_slice(ord2, (pos0,), (CK,))
                     blk_i = (blk_o & _IB_BIT) != 0
-                    nlib += jnp.sum((vl & blk_i).astype(jnp.int32))
-                    nib += jnp.sum((valid & blk_i).astype(jnp.int32))
+                    nlib += jnp.sum(vl & blk_i, dtype=jnp.int32)
+                    nib += jnp.sum(valid & blk_i, dtype=jnp.int32)
                 else:
                     # every row is in-bag: the partition counts ARE the
                     # in-bag counts
@@ -2998,4 +3006,4 @@ grow_tree = jax.jit(grow_tree_impl, static_argnames=("cfg",))
 # compile per signature emits a {"event": "compile"} record (obs/cost.py)
 from ..obs import register_jit  # noqa: E402  (after grow_tree exists)
 
-grow_tree = register_jit("ops/grow_tree", grow_tree)
+grow_tree = register_jit("ops/grow_tree", grow_tree, max_signatures=8)

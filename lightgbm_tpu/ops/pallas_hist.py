@@ -228,4 +228,5 @@ hist_from_rows_pallas_jit = jax.jit(
 from ..obs import register_jit  # noqa: E402  (after the jit exists)
 
 hist_from_rows_pallas_jit = register_jit("ops/pallas_hist",
-                                         hist_from_rows_pallas_jit)
+                                         hist_from_rows_pallas_jit,
+                                         max_signatures=8)

@@ -82,7 +82,7 @@ __all__ = [
     "resolve_hist_comm", "payload_elems", "payload_bytes",
     "splitinfo_elems", "post_reduction_elems", "post_reduction_bytes",
     "choose_parallel_mode", "collective_payloads",
-    "jaxpr_collective_payloads",
+    "jaxpr_collective_payloads", "collective_summary",
 ]
 
 #: quantization block size: one f32 scale per BLOCK elements (1.6%
@@ -562,6 +562,8 @@ def choose_parallel_mode(F: int, B: int, rows: int, world: int,
 COLLECTIVE_PRIMS = frozenset({
     "psum", "pmax", "pmin", "ppermute", "all_gather", "all_to_all",
     "reduce_scatter", "psum_scatter", "psum_invariant",
+    # jax>=0.8 varying-manual-axes (check_vma=True) names
+    "psum2",
 })
 
 
@@ -588,9 +590,12 @@ def jaxpr_collective_payloads(closed):
             for v in val:
                 yield from _sub(v)
 
+    eqn_seq = [0]
+
     def _walk(jaxpr):
         for eqn in jaxpr.eqns:
             if eqn.primitive.name in COLLECTIVE_PRIMS:
+                eqn_seq[0] += 1
                 # output side too: a psum RETURNS the full reduced
                 # operand where a psum_scatter returns 1/D of it — the
                 # out bytes are the post-reduction payload the sharded
@@ -610,6 +615,7 @@ def jaxpr_collective_payloads(closed):
                     itemsize = jnp.dtype(aval.dtype).itemsize
                     records.append({
                         "prim": eqn.primitive.name,
+                        "eqn": eqn_seq[0],
                         "elems": int(aval.size),
                         "itemsize": int(itemsize),
                         "bytes": int(aval.size) * int(itemsize),
@@ -622,3 +628,29 @@ def jaxpr_collective_payloads(closed):
 
     _walk(closed.jaxpr)
     return records
+
+
+def collective_summary(closed) -> dict:
+    """Budget view of a traced program's collectives — the numbers
+    ``lint --ir`` (TPL012, analysis/ircheck.py) diffs against the
+    committed ``tools/ir_budgets.json``:
+
+    - ``wire_bytes``: total operand bytes entering collectives (the
+      payload the int8/int16 hist wire shrinks 4x/2x),
+    - ``post_reduction_bytes``: total bytes the collectives RETURN
+      (the payload ``split_search=sharded``'s psum_scatter cuts ~D x
+      vs a full psum),
+    - ``n_collectives`` / ``prims``: the collective census.
+
+    Out-bytes are counted once per collective *equation* (a
+    multi-operand psum contributes one output, not one per operand)."""
+    records = jaxpr_collective_payloads(closed)
+    out_by_eqn = {}
+    for r in records:
+        out_by_eqn[r["eqn"]] = r["bytes_out"]
+    return {
+        "n_collectives": len(out_by_eqn),
+        "prims": sorted({r["prim"] for r in records}),
+        "wire_bytes": sum(r["bytes"] for r in records),
+        "post_reduction_bytes": sum(out_by_eqn.values()),
+    }

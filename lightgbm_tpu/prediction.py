@@ -265,7 +265,7 @@ def _forest_leaves(stacked: StackedTrees, X: jnp.ndarray) -> jnp.ndarray:
 from .obs import register_jit  # noqa: E402  (after the jitted defs)
 
 _forest_leaves = register_jit("prediction/forest_leaves",
-                              _forest_leaves)
+                              _forest_leaves, max_signatures=16)
 
 
 def _predict_leaves_jit(stacked, X, T):

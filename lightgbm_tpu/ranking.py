@@ -70,7 +70,10 @@ def _inverse_max_dcg(gains: jnp.ndarray, mask: jnp.ndarray,
     g = jnp.where(mask, gains, -jnp.inf)
     g_sorted = -jnp.sort(-g, axis=-1)
     pos = jnp.arange(g.shape[-1])
-    disc = 1.0 / jnp.log2(2.0 + pos)
+    # position discount pinned to the gains dtype: bare `2.0 + pos`
+    # promotes through the default int/float (f64 under x64) and would
+    # drag the whole lambda chain out of f32
+    disc = 1.0 / jnp.log2(2.0 + pos.astype(g.dtype))
     use = (pos[None, :] < k) & jnp.isfinite(g_sorted)
     dcg = jnp.sum(jnp.where(use, g_sorted * disc[None, :], 0.0), axis=-1)
     return jnp.where(dcg > 0, 1.0 / dcg, 0.0)
@@ -174,7 +177,8 @@ def _lambdarank_grads(score, q_idx, q_mask, gain_of_row, weight,
         s = score[idx_b] * mask_b            # [blk, Q]
         s = jnp.where(mask_b, s, -jnp.inf)
         ranks = _ranks_desc(s, mask_b)       # [blk, Q]
-        disc = jnp.where(mask_b, 1.0 / jnp.log2(2.0 + ranks), 0.0)
+        disc = jnp.where(
+            mask_b, 1.0 / jnp.log2(2.0 + ranks.astype(s.dtype)), 0.0)
         # pairwise tensors [blk, Q, Q]
         sd = jnp.where(mask_b, score[idx_b], 0.0)
         s_diff = sd[:, :, None] - sd[:, None, :]
@@ -233,7 +237,7 @@ def _lambdarank_grads(score, q_idx, q_mask, gain_of_row, weight,
 
 
 _lambdarank_grads = register_jit("ranking/lambdarank_grads",
-                                 _lambdarank_grads)
+                                 _lambdarank_grads, max_signatures=8)
 
 
 class RankXENDCG(Objective):

@@ -43,7 +43,8 @@ from ..obs import register_jit
 from ..ops.predict import StackedTrees, predict_leaf_raw
 from ..prediction import convert_raw_scores, stack_trees
 
-__all__ = ["CompiledForest", "compile_forest", "bucket_rows"]
+__all__ = ["CompiledForest", "compile_forest", "bucket_rows",
+           "n_serve_buckets"]
 
 
 def bucket_rows(n: int, min_bucket: int = 16,
@@ -56,6 +57,17 @@ def bucket_rows(n: int, min_bucket: int = 16,
         raise ValueError(f"batch must have at least one row, got {n}")
     b = 1 << (int(n) - 1).bit_length()
     return max(min_bucket, min(b, max_bucket))
+
+
+def n_serve_buckets(min_bucket: int = 16,
+                    max_bucket: int = 16384) -> int:
+    """Number of distinct pow2 row buckets ``bucket_rows`` can emit —
+    the per-model compile ceiling of the serving program, and the
+    floor ``lint --ir`` (TPL014) holds the ``serve/predict``
+    ``max_signatures`` declaration against."""
+    import math
+
+    return int(math.log2(max_bucket // min_bucket)) + 1
 
 
 @partial(jax.jit, static_argnums=(2,))
@@ -89,8 +101,12 @@ def _predict_scores_padded(stacked: StackedTrees, X: jnp.ndarray,
     return scores.T                                      # [n, K]
 
 
+# the declared recompile surface is the full pow2 bucket ladder twice
+# over (two live tree-count/K layouts per process — a hot swap staging
+# a differently-shaped forest compiles its own ladder)
 _predict_scores_padded = register_jit("serve/predict",
-                                      _predict_scores_padded)
+                                      _predict_scores_padded,
+                                      max_signatures=2 * n_serve_buckets())
 
 
 @partial(jax.jit, donate_argnums=(0,))

@@ -1,0 +1,12 @@
+"""TPL011 negative: a python-float literal routed through ``where``.
+Under x64 it appears as a WEAK rank-0 f64 scalar that immediately
+``convert_element_type``s down to f32 — benign literal plumbing the
+rule exempts (flagging it would mean pinning every scalar literal in
+the tree for zero generated-code difference)."""
+
+
+def build(jax, jnp):
+    def fn(x):
+        return jnp.where(x > 0.0, x, 0.0)
+
+    return fn, (jnp.ones((4,), jnp.float32),)

@@ -1,0 +1,11 @@
+"""TPL013 negative: same-shape donate — the lowered program carries
+one ``tf.aliasing_output`` marker for the donated input, honoring the
+declaration, so no finding."""
+
+
+def build(jax, jnp):
+    fn = jax.jit(lambda x: x * 2.0, donate_argnums=(0,))
+    return fn, (jnp.ones((8,), jnp.float32),)
+
+
+DONATE = (0,)

@@ -1,0 +1,14 @@
+"""TPL013 positive: ``donate_argnums`` declared on a jit whose output
+shape differs from the donated input — XLA cannot alias the buffers,
+so the lowered StableHLO carries zero ``tf.aliasing_output`` markers
+and the declared donation is silently dead. The finding anchors at the
+DONATE line (the contract under review)."""
+
+
+def build(jax, jnp):
+    fn = jax.jit(lambda x: jnp.concatenate([x, x]), donate_argnums=(0,))
+    return fn, (jnp.ones((8,), jnp.float32),)
+
+
+# EXPECT: TPL013
+DONATE = (0,)

@@ -1,0 +1,13 @@
+"""TPL014 negative: the same entry point WITH a ``max_signatures``
+declaration — the recompile surface is committed, so no finding."""
+
+
+def _identity(x):
+    return x
+
+
+def register_jit(name, fn, max_signatures=None):
+    return fn
+
+
+F = register_jit("fixture/declared", _identity, max_signatures=4)

@@ -1,0 +1,16 @@
+"""TPL014 positive: a ``register_jit`` entry point with no
+``max_signatures`` declaration. AST-scanned only (never imported) by
+``analysis.ircheck.register_jit_sites`` — the local stub keeps the
+file import-safe without touching the real registry."""
+
+
+def _identity(x):
+    return x
+
+
+def register_jit(name, fn, max_signatures=None):
+    return fn
+
+
+# EXPECT: TPL014
+F = register_jit("fixture/undeclared", _identity)

@@ -262,6 +262,11 @@ def test_cli_lint_runs_without_jax():
         "import sys\n"
         "from lightgbm_tpu.analysis.cli import main\n"
         "rc = main(['--format', 'json'])\n"
+        # the --ir flag family must also parse (and reject misuse)
+        # without dragging jax in: only an actual --ir run may import
+        # it
+        "assert main(['--ir-entry', 'parallel/dp_grow']) == 2\n"
+        "assert main(['--rule', 'TPL011']) == 2\n"
         "assert 'jax' not in sys.modules, 'lint imported jax!'\n"
         "sys.exit(rc)\n"
     )
@@ -295,6 +300,7 @@ def test_cli_help_mentions_exit_codes():
     text = build_parser().format_help()
     assert "exit codes:" in text
     assert "--rule" in text and "--baseline" in text
+    assert "--ir" in text and "--ir-entry" in text
     assert EXIT_CODES.strip().splitlines()[1].strip().startswith("0")
 
 

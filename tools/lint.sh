@@ -5,7 +5,23 @@
 # No jax import happens on this path — safe for backend-less runners.
 # Pre-commit loop: `tools/lint.sh --changed` lints only files differing
 # from HEAD (~100 ms when nothing in scope changed).
+#
+# IR stage: `tools/lint.sh --ir` additionally lowers every
+# register_jit entry point (CPU, lowering only — works on hosts with
+# no TPU) and checks TPL011-TPL014 against tools/ir_budgets.json. The
+# stage is pinned to the CPU backend and fenced by a wall-clock budget
+# (LINT_IR_TIMEOUT seconds, default 90; the full table lowers in ~10s)
+# so a pathological trace can never hang CI.
 set -eu
 cd "$(dirname "$0")/.."
+for arg in "$@"; do
+    if [ "$arg" = "--ir" ]; then
+        JAX_PLATFORMS=cpu
+        export JAX_PLATFORMS
+        exec timeout -k 10 "${LINT_IR_TIMEOUT:-90}" \
+            python -m lightgbm_tpu lint --strict \
+            --baseline tools/tpulint_baseline.txt "$@"
+    fi
+done
 exec python -m lightgbm_tpu lint --strict \
     --baseline tools/tpulint_baseline.txt "$@"
