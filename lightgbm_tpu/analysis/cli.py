@@ -36,7 +36,11 @@ def build_parser() -> argparse.ArgumentParser:
             "storms, donation violations, order-unstable iteration, "
             "locks across dispatch, rank-divergent collective order, "
             "thread-shared-state races, float64 promotion leaks, "
-            "device collectives under traced conditionals). "
+            "device collectives under traced conditionals) plus the "
+            "cross-process contract pass TPL015-TPL018 (JSONL event "
+            "schemas, metric families, LIGHTGBM_TPU_* env vars, and "
+            "fault kinds checked against the single-source registries "
+            "in obs/schemas.py). "
             "With --ir it additionally lowers every register_jit "
             "entry point on CPU (never executing) and checks the IR "
             "contracts TPL011-TPL014 (strong float64 in the jaxpr, "
@@ -62,7 +66,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--rule", metavar="TPLNNN", action="append",
                    default=None,
                    help="run only this rule (repeatable); default: "
-                        "TPL001-TPL010 (TPL011-TPL014 also need "
+                        "TPL001-TPL010 and the contract pass "
+                        "TPL015-TPL018 (TPL011-TPL014 also need "
                         "--ir)")
     p.add_argument("--ir", action="store_true",
                    help="also lower every register_jit entry point "

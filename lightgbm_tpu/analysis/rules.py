@@ -51,6 +51,7 @@ class LintContext:
     graph: CallGraph
     scans: Dict[str, ModuleScan]
     scope: Set[str]                      # relpaths the rules run over
+    root: str = ""                       # package dir (doc checks only)
 
     def scoped_scans(self) -> Iterator[ModuleScan]:
         for rel in sorted(self.scope):
@@ -544,14 +545,17 @@ class LockAcrossDispatch(Rule):
                     break
 
 
-#: imported at the bottom on purpose: rules_flow subclasses Rule/uses
-#: Finding, so it needs this module's upper half to exist first. Import
-#: THIS module (or the package) for the full rule set.
+#: imported at the bottom on purpose: rules_flow/rules_contract
+#: subclass Rule/use Finding, so they need this module's upper half to
+#: exist first. Import THIS module (or the package) for the full rule
+#: set.
 from .rules_flow import FLOW_RULES  # noqa: E402
+from .rules_contract import CONTRACT_RULES  # noqa: E402
 
 ALL_RULES: List[Rule] = [EagerLaxLoop(), HostSync(), RecompileHazard(),
                          DonationViolation(), UnorderedIteration(),
-                         LockAcrossDispatch(), *FLOW_RULES]
+                         LockAcrossDispatch(), *FLOW_RULES,
+                         *CONTRACT_RULES]
 
 
 # ---------------------------------------------------------------------

@@ -45,9 +45,13 @@ from .jit_tracker import (RecompileWatcher, jit_cache_sizes,
                           total_recompiles)
 from .memory import device_memory_stats
 from .recorder import (ITERATION_EVENT_KEYS, TelemetryRecorder,
-                       merge_fleet_summaries, render_fleet_table,
-                       render_stats_table, summarize_directory,
-                       summarize_events)
+                       UnknownEventError, merge_fleet_summaries,
+                       render_fleet_table, render_stats_table,
+                       summarize_directory, summarize_events)
+from .schemas import (ENV_VARS, EVENT_NAMES, EVENTS, FAULT_EVENT_KINDS,
+                      FAULT_KINDS, METRICS, event_keys,
+                      fault_event_kinds, injectable_fault_kinds,
+                      one_shot_fault_kinds, required_keys)
 from .registry import Counter, Gauge, Histogram, MetricsRegistry, registry
 from .trace import (SPAN_EVENT_KEYS, current_context, drain_span_events,
                     new_span_id, new_trace_id, record_span,
@@ -58,7 +62,11 @@ __all__ = [
     "register_jit", "jit_cache_sizes", "jit_declarations",
     "total_recompiles",
     "RecompileWatcher", "device_memory_stats",
-    "TelemetryRecorder", "ITERATION_EVENT_KEYS",
+    "TelemetryRecorder", "ITERATION_EVENT_KEYS", "UnknownEventError",
+    "EVENTS", "EVENT_NAMES", "METRICS", "ENV_VARS", "FAULT_KINDS",
+    "FAULT_EVENT_KINDS", "event_keys", "required_keys",
+    "injectable_fault_kinds", "one_shot_fault_kinds",
+    "fault_event_kinds",
     "summarize_events", "render_stats_table",
     "summarize_directory", "merge_fleet_summaries",
     "render_fleet_table",

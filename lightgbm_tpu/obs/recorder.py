@@ -31,25 +31,35 @@ from .jit_tracker import RecompileWatcher
 from .memory import device_memory_stats
 from .registry import MetricsRegistry
 from .registry import registry as _global_registry
+from .schemas import EVENT_NAMES, required_keys
 
 __all__ = ["TelemetryRecorder", "ITERATION_EVENT_KEYS",
+           "UnknownEventError",
            "summarize_events", "render_stats_table", "ENTRY_PHASES",
            "summarize_directory", "merge_fleet_summaries",
            "render_fleet_table"]
 
-#: required keys of every iteration event (the JSONL schema contract).
-#: ``comm`` is the collective-payload record of distributed training
-#: (payload bytes from the dtype-aware model in parallel/comms.py,
-#: the hist_comm wire mode, and the parallelism mode chosen) — null
-#: on single-device runs, which move no bytes. ``scan`` is the fused
-#: scan-window position of the iteration (models/gbdt.py
-#: ``fused_scan_iters``, docs/FUSED.md): ``{"window": W, "pos": j,
-#: "dispatch": bool}`` — the dispatch event absorbs the whole window's
-#: device phase time, the other W-1 events in the window are
-#: host-side pops — or null on per-iteration paths.
-ITERATION_EVENT_KEYS = ("event", "iteration", "wall_time", "phases",
-                        "recompiles", "hbm", "tree", "eval", "comm",
-                        "scan")
+#: required keys of every iteration event — derived from the
+#: single-source schema registry (obs/schemas.py EVENTS, the TPL015
+#: contract; semantics documented there and in docs/OBSERVABILITY.md).
+#: Re-exported here because the recorder is the canonical emitter and
+#: tests/harnesses historically import it from this module.
+ITERATION_EVENT_KEYS = required_keys("iteration")
+
+
+class UnknownEventError(ValueError):
+    """A telemetry stream carried an event name the schema registry
+    (obs/schemas.py EVENTS) does not declare — a corrupt or
+    foreign-version stream. Raised by :func:`summarize_events` instead
+    of silently skipping the line (a truncated FINAL line is still
+    tolerated at the JSON-parse level, like every stream reader)."""
+
+    def __init__(self, name: str, path: str = ""):
+        self.event_name = name
+        where = f" in {path}" if path else ""
+        super().__init__(
+            f"undeclared telemetry event {name!r}{where} — not in the "
+            f"obs/schemas.py EVENTS registry")
 
 
 class TelemetryRecorder:
@@ -481,6 +491,13 @@ def summarize_events(path: str) -> dict:
     # truncated crash artifact — only when nothing non-empty follows
     events = _stream_lines(path, _parse)
     for ev in events:
+        name = ev.get("event")
+        if not isinstance(name, str) or name not in EVENT_NAMES:
+            # an undeclared event name means a corrupt or
+            # foreign-version stream, not a crash artifact — refuse
+            # loudly instead of silently skipping (a truncated FINAL
+            # line was already handled above, at the JSON level)
+            raise UnknownEventError(str(name), path)
         if ev.get("event") == "fault":
             kind = str(ev.get("kind", "unknown"))
             faults[kind] = faults.get(kind, 0) + 1

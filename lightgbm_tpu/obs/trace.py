@@ -69,9 +69,13 @@ __all__ = ["SPAN_EVENT_KEYS", "FUSED_SCAN_PHASE", "BLOCKING_PHASES",
            "correct_clock_skew", "chrome_trace", "critical_paths",
            "render_critical_paths", "main"]
 
-#: the JSONL schema contract of every ``{"event": "span"}`` line
-SPAN_EVENT_KEYS = ("event", "name", "trace_id", "span_id",
-                   "parent_id", "wall", "mono", "dur", "proc", "attrs")
+#: the JSONL schema contract of every ``{"event": "span"}`` line —
+#: derived from the single-source registry (obs/schemas.py EVENTS,
+#: the TPL015 contract) and re-exported here for the span emitters
+#: and tests that historically import it from this module
+from .schemas import required_keys as _required_keys  # noqa: E402
+
+SPAN_EVENT_KEYS = _required_keys("span")
 
 #: the Timer phase that blocks INSIDE a fused-scan window's
 #: train_one_iter call (the window-boundary batched fetch,
@@ -115,7 +119,7 @@ def _proc_label() -> str:
     # derived per span, not cached: spans land per iteration/request
     # (never per row), and a cache would be one more thread-shared
     # field to guard across the pipeline's fork tree
-    rank = os.environ.get("LIGHTGBM_TPU_RANK", "")
+    rank = os.environ.get("LIGHTGBM_TPU_RANK") or ""
     return f"pid{os.getpid()}" + (f".rank{rank}" if rank else "")
 
 

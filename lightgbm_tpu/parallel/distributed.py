@@ -196,7 +196,7 @@ def init_distributed(coordinator_address: Optional[str] = None,
         num_processes = len(mlist)
         if process_id is None:
             rank = local_rank if local_rank is not None else int(
-                os.environ.get("LIGHTGBM_TPU_RANK", "-1"))
+                os.environ.get("LIGHTGBM_TPU_RANK") or -1)
             if rank < 0:
                 raise ValueError(
                     "machine-list initialization needs local_rank (or "

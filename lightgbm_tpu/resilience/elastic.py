@@ -87,8 +87,12 @@ __all__ = ["main", "supervise", "supervise_fleet", "worker_env",
            "strip_one_shot_faults", "RestartBudget", "replica_ping",
            "replica_rpc", "fleet_telemetry_path"]
 
-#: fault kinds that must not re-fire after a supervised restart
-_ONE_SHOT_KINDS = ("rank_kill", "stall_rank", "serve_kill")
+#: fault kinds that must not re-fire after a supervised restart —
+#: the one_shot classification in the single-source fault registry
+#: (obs/schemas.py FAULT_KINDS, the TPL018 contract)
+from ..obs.schemas import one_shot_fault_kinds as _one_shot_kinds
+
+_ONE_SHOT_KINDS = _one_shot_kinds()
 
 _POLL_SECONDS = 0.2
 

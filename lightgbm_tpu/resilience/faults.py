@@ -89,10 +89,11 @@ __all__ = ["FaultPlan", "InjectedResourceExhausted", "InjectedInitRefused",
            "is_resource_exhausted", "append_fault_event",
            "record_fault_event", "drain_events", "FAULT_EVENTS"]
 
-_KNOWN_KINDS = ("nan_grad", "nan_hess", "oom", "kill",
-                "rank_kill", "stall_rank", "init_refuse",
-                "publish_torn", "publish_poison", "store_outage",
-                "serve_kill", "refit_nan")
+#: derived from the single-source fault registry (obs/schemas.py
+#: FAULT_KINDS, the TPL018 contract) — one declaration per kind
+from ..obs.schemas import injectable_fault_kinds as _injectable_kinds
+
+_KNOWN_KINDS = _injectable_kinds()
 
 #: process-level fault event log for faults that have no engine to hang
 #: off (init retries, watchdog timeouts, distributed injections). The
@@ -287,7 +288,7 @@ class FaultPlan:
         targets = {int(r) for r in
                    os.environ.get("LIGHTGBM_TPU_FAULT_RANK",
                                   "0").split(",") if r.strip()}
-        me = int(os.environ.get("LIGHTGBM_TPU_RANK", "0") or 0)
+        me = int(os.environ.get("LIGHTGBM_TPU_RANK") or 0)
         return me in targets
 
     def maybe_serve_kill(self, request_count: int) -> None:

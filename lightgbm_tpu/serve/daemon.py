@@ -1019,7 +1019,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"[LightGBM-TPU] [Fatal] {e}", file=sys.stderr)
         return 1
     # ---- everything below may import jax ----
-    rank = int(os.environ.get("LIGHTGBM_TPU_RANK", "0") or 0)
+    rank = int(os.environ.get("LIGHTGBM_TPU_RANK") or 0)
     port = args.port + rank if args.port else 0
     telemetry_path = args.telemetry \
         or os.environ.get("LIGHTGBM_TPU_TELEMETRY")
@@ -1075,7 +1075,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not metrics_port:
         try:
             metrics_port = int(os.environ.get(
-                "LIGHTGBM_TPU_METRICS_PORT", "0") or 0)
+                "LIGHTGBM_TPU_METRICS_PORT") or 0)
         except ValueError:
             metrics_port = 0
     metrics_server = None

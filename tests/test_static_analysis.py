@@ -201,6 +201,25 @@ _FIXTURE_GROUPS = [
      "tpl010_import_helper.py"),
 ]
 
+# contract-pass fixtures (TPL015-TPL018): each pos/neg file is linted
+# together with the mini registry at contract/obs/schemas.py — the
+# contract rules literal-eval the SCANNED tree's registry, and no-op
+# on trees without one (which keeps the single-file fixtures above
+# clean). The agg group's target is the registry itself: its
+# declared-but-never-used entries anchor whole-tree findings there.
+_CONTRACT_SCHEMAS = "contract/obs/schemas.py"
+_FIXTURE_GROUPS += [
+    ((_CONTRACT_SCHEMAS, rel), rel) for rel in (
+        "contract/tpl015_pos.py", "contract/tpl015_neg.py",
+        "contract/tpl016_pos.py", "contract/tpl016_neg.py",
+        "contract/tpl017_pos.py", "contract/tpl017_neg.py",
+        "contract/tpl018_pos.py", "contract/tpl018_neg.py",
+    )
+] + [
+    (("contract/agg/obs/schemas.py", "contract/agg/site.py"),
+     "contract/agg/obs/schemas.py"),
+]
+
 
 @pytest.mark.parametrize("relpath", _FIXTURES)
 def test_rule_fixture(relpath):
@@ -242,7 +261,8 @@ def test_fixture_positive_files_have_expectations():
 def test_every_rule_has_fixture_coverage():
     from lightgbm_tpu.analysis import ALL_RULES
     covered = set()
-    for rel in _FIXTURES:
+    targets = list(_FIXTURES) + [g[1] for g in _FIXTURE_GROUPS]
+    for rel in targets:
         for rule, _ in _expected_findings(os.path.join(FIXTURES, rel)):
             covered.add(rule)
     missing = {r.id for r in ALL_RULES} - covered
@@ -1157,3 +1177,118 @@ def test_changed_relpaths_with_package_below_repo_root(tmp_path):
     _git(repo, "commit", "-qm", "seed")
     (pkg / "models" / "m.py").write_text("A = 2\n")
     assert changed_relpaths(str(pkg), "HEAD") == {"models/m.py"}
+
+
+# ---------------------------------------------------------------------
+# 10. Contract pass (TPL015-TPL018) against the REAL tree: the shipped
+#     registries and their call sites agree, and the exact drift
+#     mutations the acceptance criteria name re-surface stable ids
+# ---------------------------------------------------------------------
+
+def _lint_mutated_contract(tmp_path, mutations, extra=()):
+    """Copy the real ``obs/schemas.py`` registry plus the named package
+    files into a tmp tree, applying the per-file ``mutations``
+    transforms, and run only the contract rules.  The registry must
+    ride along: the contract pass no-ops when obs/schemas.py is absent
+    from the scanned tree."""
+    relpaths = dict.fromkeys(
+        ["obs/schemas.py", *mutations, *extra])
+    for relpath in relpaths:
+        with open(os.path.join(PKG, relpath), encoding="utf-8") as fh:
+            src = fh.read()
+        transform = mutations.get(relpath)
+        if transform is not None:
+            mutated = transform(src)
+            assert mutated != src, f"mutation did not apply to {relpath}"
+            src = mutated
+        dst = tmp_path / relpath
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(src, encoding="utf-8")
+    return run_lint(root=str(tmp_path), package="lightgbm_tpu",
+                    files=list(relpaths), baseline_path="",
+                    rules=["TPL015", "TPL016", "TPL017", "TPL018"])
+
+
+def test_renaming_an_emitted_event_key_fails(tmp_path):
+    """The acceptance mutation: renaming ``wall_time`` inside the
+    iteration event literal drifts the wire format from the EVENTS
+    registry -> TPL015 flags both the undeclared key and the missing
+    required one, at the emitting function."""
+    res = _lint_mutated_contract(tmp_path, {
+        "obs/recorder.py": lambda src: src.replace(
+            '"wall_time": now_mono - self._t0,',
+            '"walltime": now_mono - self._t0,')})
+    fids = [f.fid for f in res.findings]
+    assert ("TPL015:obs/recorder.py:TelemetryRecorder.record_iteration:"
+            "event:iteration:keys#1") in fids, fids
+    assert ("TPL015:obs/recorder.py:TelemetryRecorder.record_iteration:"
+            "event:iteration:missing#1") in fids, fids
+
+
+def test_stripping_a_declared_env_default_fails(tmp_path):
+    """The acceptance mutation: dropping the declared default for
+    LIGHTGBM_TPU_INIT_RETRIES out of the ENV_VARS registry leaves the
+    distributed layer's ``.get(..., "10")`` claiming a default the
+    registry no longer records -> TPL017 at the reading site."""
+    res = _lint_mutated_contract(tmp_path, {
+        "obs/schemas.py": lambda src: src.replace(
+            '"LIGHTGBM_TPU_INIT_RETRIES": {\n        "default": "10",',
+            '"LIGHTGBM_TPU_INIT_RETRIES": {\n        "default": None,')},
+        extra=("parallel/distributed.py",))
+    fids = [f.fid for f in res.findings]
+    assert ("TPL017:parallel/distributed.py:_initialize_with_retry:"
+            "env:LIGHTGBM_TPU_INIT_RETRIES:default#1") in fids, fids
+
+
+def test_recording_an_undeclared_fault_kind_fails(tmp_path):
+    """The acceptance mutation: a typo'd kind in the publisher's
+    poison-event writer is invisible to every fault-log consumer
+    keyed on the registry -> TPL018 at the writing function."""
+    res = _lint_mutated_contract(tmp_path, {
+        "resilience/publisher.py": lambda src: src.replace(
+            'record_fault_event(\n                "publish_poison",',
+            'record_fault_event(\n                "publish_poizon",')})
+    fids = [f.fid for f in res.findings]
+    assert ("TPL018:resilience/publisher.py:publish_model:"
+            "fault-kind:publish_poizon#1") in fids, fids
+
+
+def test_cli_contract_rules_run_without_jax():
+    """The contract pass stays on the jax-free default path: a
+    --rule-filtered TPL015-TPL018 run over the real tree completes
+    clean in a subprocess with 'jax' absent from sys.modules."""
+    code = (
+        "import sys\n"
+        "from lightgbm_tpu.analysis.cli import main\n"
+        "rc = main(['--rule', 'TPL015', '--rule', 'TPL016',\n"
+        "           '--rule', 'TPL017', '--rule', 'TPL018',\n"
+        "           '--format', 'json'])\n"
+        "assert 'jax' not in sys.modules, 'contract lint imported jax!'\n"
+        "sys.exit(rc)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (
+        f"rc={proc.returncode}\nstdout: {proc.stdout[-2000:]}\n"
+        f"stderr: {proc.stderr[-2000:]}")
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == [], payload["findings"]
+
+
+def test_sarif_covers_contract_findings():
+    from lightgbm_tpu.analysis.report import render_sarif
+
+    res = run_lint(root=FIXTURES, package="tpulint_fixtures",
+                   files=[_CONTRACT_SCHEMAS, "contract/tpl015_pos.py"],
+                   baseline_path="",
+                   rules=["TPL015", "TPL016", "TPL017", "TPL018"])
+    payload = json.loads(render_sarif(res))
+    run = payload["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"TPL015", "TPL016", "TPL017", "TPL018"} <= rule_ids
+    hits = [r for r in run["results"] if r["ruleId"] == "TPL015"]
+    assert hits, "the TPL015 positive fixture must surface in SARIF"
+    for r in hits:
+        assert r["partialFingerprints"]["tpulintFindingId/v1"] \
+            .startswith("TPL015:")
+        assert r["message"]["text"]

@@ -366,3 +366,50 @@ def test_fault_event_drain_is_atomic_under_concurrent_appends():
         "across concurrent drains")
     assert len({ev["detail"] for ev in mine}) == n_threads * per_thread
     assert not faults.FAULT_EVENTS
+
+
+def test_event_key_lists_are_the_schema_registry():
+    """Satellite of the contract-lint PR: exactly one declaration per
+    event. The recorder's ITERATION_EVENT_KEYS and the fault machinery
+    are derived views of obs/schemas.py, never parallel lists."""
+    from lightgbm_tpu.obs import schemas
+    from lightgbm_tpu.resilience import elastic, faults
+    assert ITERATION_EVENT_KEYS == \
+        tuple(schemas.EVENTS["iteration"]["required"])
+    assert faults._KNOWN_KINDS == schemas.injectable_fault_kinds()
+    assert elastic._ONE_SHOT_KINDS == schemas.one_shot_fault_kinds()
+    # the one-shot strip list is a subset classification of the
+    # injectable kinds, not an independent registry
+    assert set(elastic._ONE_SHOT_KINDS) <= set(faults._KNOWN_KINDS)
+    # every declared event carries "event" itself as a required key
+    for name, spec in schemas.EVENTS.items():
+        assert "event" in spec["required"], name
+
+
+def test_summarize_events_rejects_undeclared_event(tmp_path):
+    """Ride-along bugfix: an undeclared event name is a corrupt or
+    foreign-version stream -> named error, not a silent skip (and
+    never a KeyError)."""
+    from lightgbm_tpu.obs import UnknownEventError
+    path = tmp_path / "bad.jsonl"
+    path.write_text(
+        json.dumps({"event": "fault", "kind": "nonfinite",
+                    "iteration": 0, "action": "skip_tree",
+                    "detail": "x", "time": 1.0}) + "\n"
+        + json.dumps({"event": "iterration", "iteration": 0}) + "\n")
+    with pytest.raises(UnknownEventError) as exc:
+        summarize_events(str(path))
+    assert exc.value.event_name == "iterration"
+    assert "iterration" in str(exc.value)
+
+
+def test_summarize_events_undeclared_tolerates_truncated_tail(tmp_path):
+    """The truncated-final-line tolerance survives the undeclared-name
+    check: a SIGKILL mid-write still yields the stream's summary."""
+    good = json.dumps({"event": "fault", "kind": "nonfinite",
+                       "iteration": 0, "action": "skip_tree",
+                       "detail": "x", "time": 1.0})
+    path = tmp_path / "cut.jsonl"
+    path.write_text(good + "\n" + '{"event": "iterr')  # torn tail
+    summary = summarize_events(str(path))
+    assert summary["faults"] == {"nonfinite": 1}

@@ -580,3 +580,11 @@ def test_timed_annotates_only_while_capture_live(tmp_path):
     assert tm.timed("x") is tm._NULL
     # the capture actually materialized profile artifacts
     assert any((tmp_path / "prof").rglob("*"))
+
+
+def test_span_keys_are_the_schema_registry():
+    """Satellite of the contract-lint PR: SPAN_EVENT_KEYS is a derived
+    view of the single-source schema registry (obs/schemas.py)."""
+    from lightgbm_tpu.obs import schemas
+    assert T.SPAN_EVENT_KEYS == \
+        tuple(schemas.EVENTS["span"]["required"])

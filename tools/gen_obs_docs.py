@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""Regenerate docs/OBSERVABILITY.md tables from obs/schemas.py.
+
+The registry module (lightgbm_tpu/obs/schemas.py) is the single
+source of truth for the cross-process plane: JSONL event schemas,
+metric families, LIGHTGBM_TPU_* env vars. This tool renders them as
+markdown tables and splices each between its marker pair
+
+    <!-- BEGIN GENERATED: <block> (tools/gen_obs_docs.py) -->
+    ...
+    <!-- END GENERATED: <block> -->
+
+so the prose around the tables stays hand-written while the
+name/kind/label/default columns can never drift from the code.
+
+    python tools/gen_obs_docs.py --write   # regenerate in place
+    python tools/gen_obs_docs.py --check   # exit 1 on drift (lint.sh)
+
+Jax-free: the registry is loaded by file path, never through the
+package __init__.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCHEMAS = os.path.join(REPO, "lightgbm_tpu", "obs", "schemas.py")
+DOC = os.path.join(REPO, "docs", "OBSERVABILITY.md")
+
+_BEGIN = "<!-- BEGIN GENERATED: {name} (tools/gen_obs_docs.py) -->"
+_END = "<!-- END GENERATED: {name} -->"
+
+
+def load_schemas():
+    spec = importlib.util.spec_from_file_location(
+        "lightgbm_tpu_obs_schemas_standalone", SCHEMAS)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _cell(s: str) -> str:
+    return s.replace("|", "\\|")       # never break the table grammar
+
+
+def _code(s: str) -> str:
+    return f"`{_cell(s)}`"
+
+
+def _keys(keys) -> str:
+    return " ".join(_code(k) for k in keys) or "—"
+
+
+def render_env(schemas) -> str:
+    rows = ["| Variable | Default | Effect |", "| --- | --- | --- |"]
+    for name in sorted(schemas.ENV_VARS):
+        spec = schemas.ENV_VARS[name]
+        default = spec.get("default")
+        shown = "*(unset)*" if default is None else _code(repr(default))
+        rows.append(f"| {_code(name)} | {shown} | {_cell(spec['doc'])} |")
+    return "\n".join(rows)
+
+
+def render_events(schemas) -> str:
+    rows = ["| Event | Required keys | Optional keys | Meaning |",
+            "| --- | --- | --- | --- |"]
+    for name in sorted(schemas.EVENTS):
+        spec = schemas.EVENTS[name]
+        rows.append(
+            f"| {_code(name)} | {_keys(spec.get('required', ()))} "
+            f"| {_keys(spec.get('optional', ()))} | {_cell(spec['doc'])} |")
+    return "\n".join(rows)
+
+
+def render_metrics(schemas) -> str:
+    rows = ["| Family | Kind | Labels | Meaning |",
+            "| --- | --- | --- | --- |"]
+    for name in sorted(schemas.METRICS):
+        spec = schemas.METRICS[name]
+        labels = ", ".join(
+            _code(lb) for lb in spec.get("labels", ())) or "—"
+        rows.append(f"| {_code(name)} | {spec['kind']} | {labels} "
+                    f"| {_cell(spec['doc'])} |")
+    return "\n".join(rows)
+
+
+def render_export(schemas) -> str:
+    rows = ["| Sample family | Kind | Exported by |",
+            "| --- | --- | --- |"]
+    for name in sorted(schemas.EXPORT_FAMILIES):
+        spec = schemas.EXPORT_FAMILIES[name]
+        rows.append(f"| {_code(name)} | {spec['kind']} "
+                    f"| {_cell(spec['doc'])} |")
+    return "\n".join(rows)
+
+
+BLOCKS = {
+    "env-vars": render_env,
+    "events": render_events,
+    "metrics": render_metrics,
+    "export-families": render_export,
+}
+
+
+def splice(text: str, schemas) -> str:
+    for name, render in BLOCKS.items():
+        begin, end = _BEGIN.format(name=name), _END.format(name=name)
+        pattern = re.compile(re.escape(begin) + r".*?" + re.escape(end),
+                             re.S)
+        if not pattern.search(text):
+            raise SystemExit(
+                f"gen_obs_docs: marker pair for {name!r} missing from "
+                f"{os.path.relpath(DOC, REPO)}")
+        block = f"{begin}\n{render(schemas)}\n{end}"
+        text = pattern.sub(lambda _m: block, text, count=1)
+    return text
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--write", action="store_true",
+                      help="regenerate the doc tables in place")
+    mode.add_argument("--check", action="store_true",
+                      help="exit 1 when the doc drifted from the "
+                           "registry (CI/lint.sh mode)")
+    args = ap.parse_args(argv)
+
+    schemas = load_schemas()
+    with open(DOC, encoding="utf-8") as fh:
+        current = fh.read()
+    regenerated = splice(current, schemas)
+    if args.check:
+        if regenerated != current:
+            print("gen_obs_docs: docs/OBSERVABILITY.md tables drifted "
+                  "from lightgbm_tpu/obs/schemas.py — run "
+                  "`python tools/gen_obs_docs.py --write`",
+                  file=sys.stderr)
+            return 1
+        print("gen_obs_docs: docs/OBSERVABILITY.md is in sync")
+        return 0
+    if regenerated != current:
+        with open(DOC, "w", encoding="utf-8") as fh:
+            fh.write(regenerated)
+        print("gen_obs_docs: rewrote generated tables in "
+              "docs/OBSERVABILITY.md")
+    else:
+        print("gen_obs_docs: docs/OBSERVABILITY.md already in sync")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

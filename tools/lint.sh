@@ -12,6 +12,12 @@
 # stage is pinned to the CPU backend and fenced by a wall-clock budget
 # (LINT_IR_TIMEOUT seconds, default 90; the full table lowers in ~10s)
 # so a pathological trace can never hang CI.
+#
+# The default (jax-free) stage also runs the contract pass
+# TPL015-TPL018 against the obs/schemas.py registries and verifies the
+# generated docs/OBSERVABILITY.md tables haven't drifted from them
+# (tools/gen_obs_docs.py --check; regenerate with --write). It is
+# fenced by LINT_TIMEOUT seconds (default 60; a full run takes ~7s).
 set -eu
 cd "$(dirname "$0")/.."
 for arg in "$@"; do
@@ -23,5 +29,7 @@ for arg in "$@"; do
             --baseline tools/tpulint_baseline.txt "$@"
     fi
 done
-exec python -m lightgbm_tpu lint --strict \
+python tools/gen_obs_docs.py --check
+exec timeout -k 10 "${LINT_TIMEOUT:-60}" \
+    python -m lightgbm_tpu lint --strict \
     --baseline tools/tpulint_baseline.txt "$@"
